@@ -1,0 +1,22 @@
+"""dalle_pytorch_tpu — a TPU-native (JAX/XLA/Pallas/GSPMD) text-to-image
+framework with the capabilities of NomadicDaggy/DALLE-pytorch.
+
+Public surface mirrors the reference package exports
+(`/root/reference/dalle_pytorch/__init__.py`): DALLE, CLIP, DiscreteVAE (+
+pretrained VAE wrappers), plus the config/partitioning machinery that
+replaces the reference's CUDA/DeepSpeed runtime.
+"""
+
+from .models.vae import DiscreteVAE, VAEConfig
+from .models.dalle import DALLE, DALLEConfig
+from .models.clip import CLIP, CLIPConfig
+from .models.pretrained_vae import OpenAIDiscreteVAE, VQGanVAE1024
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DiscreteVAE", "VAEConfig",
+    "DALLE", "DALLEConfig",
+    "CLIP", "CLIPConfig",
+    "OpenAIDiscreteVAE", "VQGanVAE1024",
+]

@@ -1,0 +1,224 @@
+"""Data pipeline: image folders and paired text-image datasets.
+
+Capability parity with the reference's two datasets:
+* ``ImageFolderDataset`` — resize + center-crop image folder for VAE training
+  (`/root/reference/train_vae.py:71-79`, torchvision ``ImageFolder``).
+* ``TextImageDataset`` — pairs ``*.txt`` caption files with images by file
+  stem, samples a random caption line, RandomResizedCrop
+  (`/root/reference/train_dalle.py:201-247`).
+
+Design: pure Python/numpy/PIL producers feeding a threaded prefetcher
+(`Prefetcher`).  Outputs are numpy NHWC float32 in [0, 1] — device transfer
+and sharding happen in the train loop (parallel/backend.py), keeping the
+loader host-only.  Per-host sharding (`shard_num_hosts``/``shard_index``)
+replaces torch's ``DistributedSampler`` (`train_dalle.py:261-269`).
+"""
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
+
+
+def _load_image(path: Path):
+    from PIL import Image
+
+    img = Image.open(path)
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    return img
+
+
+def _to_float_array(img) -> np.ndarray:
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    return arr
+
+
+def center_crop_resize(img, size: int):
+    from PIL import Image
+
+    w, h = img.size
+    scale = size / min(w, h)
+    img = img.resize((max(size, round(w * scale)), max(size, round(h * scale))),
+                     Image.BILINEAR)
+    w, h = img.size
+    left, top = (w - size) // 2, (h - size) // 2
+    return img.crop((left, top, left + size, top + size))
+
+
+def random_resized_crop(img, size: int, rng: np.random.Generator,
+                        scale=(0.6, 1.0), ratio=(1.0, 1.0)):
+    """RandomResizedCrop with the reference's settings: area scale in
+    ``(resize_ratio, 1)``, aspect ratio fixed to 1 (train_dalle.py:227)."""
+    from PIL import Image
+
+    w, h = img.size
+    area = w * h
+    for _ in range(10):
+        target_area = area * rng.uniform(scale[0], scale[1])
+        aspect = np.exp(rng.uniform(np.log(ratio[0]), np.log(ratio[1])))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            left = int(rng.integers(0, w - cw + 1))
+            top = int(rng.integers(0, h - ch + 1))
+            img = img.crop((left, top, left + cw, top + ch))
+            return img.resize((size, size), Image.BILINEAR)
+    return center_crop_resize(img, size)  # fallback, as torchvision does
+
+
+class ImageFolderDataset:
+    """Recursively lists images under `folder`; yields [H, W, 3] float32."""
+
+    def __init__(self, folder: str | Path, image_size: int = 128, train: bool = True):
+        self.paths = sorted(
+            p for p in Path(folder).rglob("*") if p.suffix.lower() in IMAGE_EXTS
+        )
+        assert len(self.paths) > 0, f"no images found under {folder}"
+        self.image_size = image_size
+        self.train = train
+
+    def __len__(self):
+        return len(self.paths)
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        img = _load_image(self.paths[idx])
+        img = center_crop_resize(img, self.image_size)
+        return _to_float_array(img)
+
+
+class TextImageDataset:
+    """Stem-paired (caption txt, image) dataset (train_dalle.py:201-247)."""
+
+    def __init__(self, folder: str | Path, tokenizer, text_len: int = 256,
+                 image_size: int = 128, resize_ratio: float = 0.6,
+                 truncate_captions: bool = False, seed: int = 0):
+        path = Path(folder)
+        text_files = {p.stem: p for p in path.rglob("*.txt")}
+        image_files = {
+            p.stem: p for p in path.rglob("*") if p.suffix.lower() in IMAGE_EXTS
+        }
+        keys = sorted(image_files.keys() & text_files.keys())
+        self.keys = keys
+        self.text_files = {k: text_files[k] for k in keys}
+        self.image_files = {k: image_files[k] for k in keys}
+        self.tokenizer = tokenizer
+        self.text_len = text_len
+        self.image_size = image_size
+        self.resize_ratio = resize_ratio
+        self.truncate_captions = truncate_captions
+        self.seed = seed
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        return len(self.keys)
+
+    def __getitem__(self, idx: int):
+        # fresh per-call Generator: numpy Generators are not thread-safe and
+        # __getitem__ runs concurrently under the prefetching DataLoader
+        with self._lock:
+            self._counter += 1
+            draw = self._counter
+        rng = np.random.default_rng((self.seed, idx, draw))
+
+        key = self.keys[idx]
+        descriptions = [
+            line for line in self.text_files[key].read_text().split("\n") if line
+        ]
+        description = descriptions[int(rng.integers(len(descriptions)))]
+        tokens = self.tokenizer.tokenize(
+            description, self.text_len, truncate_text=self.truncate_captions
+        )[0]
+        img = _load_image(self.image_files[key])
+        img = random_resized_crop(img, self.image_size, rng,
+                                  scale=(self.resize_ratio, 1.0))
+        return tokens, _to_float_array(img)
+
+
+class DataLoader:
+    """Shuffling, batching, host-sharding iterator with threaded prefetch.
+
+    `shard_num_hosts`/`shard_index` give each JAX process a disjoint slice of
+    every epoch's permutation with drop-last semantics — the GSPMD analog of
+    torch's DistributedSampler (ref train_dalle.py:261-269).
+    """
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = True,
+                 drop_last: bool = True, seed: int = 0,
+                 shard_num_hosts: int = 1, shard_index: int = 0,
+                 num_workers: int = 8, prefetch: int = 4):
+        self.ds = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        self.shard_num_hosts = shard_num_hosts
+        self.shard_index = shard_index
+        self.num_workers = num_workers
+        self.prefetch = prefetch
+
+    def __len__(self):
+        n = len(self.ds) // self.shard_num_hosts
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _epoch_indices(self) -> np.ndarray:
+        n = len(self.ds)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            idx = rng.permutation(n)
+        else:
+            idx = np.arange(n)
+        per_host = n // self.shard_num_hosts
+        return idx[self.shard_index * per_host : (self.shard_index + 1) * per_host]
+
+    def __iter__(self) -> Iterator:
+        indices = self._epoch_indices()
+        self.epoch += 1
+        batches = [
+            indices[i : i + self.batch_size]
+            for i in range(0, len(indices) - self.batch_size + 1, self.batch_size)
+        ]
+        if not self.drop_last and len(indices) % self.batch_size:
+            batches.append(indices[-(len(indices) % self.batch_size):])
+
+        if self.num_workers <= 0:
+            for b in batches:
+                yield self._collate([self.ds[int(i)] for i in b])
+            return
+
+        yield from self._prefetch_iter(batches)
+
+    def _collate(self, items):
+        if isinstance(items[0], tuple):
+            cols = list(zip(*items))
+            return tuple(np.stack(c) for c in cols)
+        return np.stack(items)
+
+    def _prefetch_iter(self, batches):
+        """Ordered prefetch with real backpressure: at most `prefetch`
+        batches are in flight; the consumer blocks on the next future."""
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        def load(batch_idx):
+            return self._collate([self.ds[int(i)] for i in batch_idx])
+
+        with ThreadPoolExecutor(max_workers=self.num_workers) as ex:
+            pending = deque()
+            it = iter(batches)
+            for b in batches[: self.prefetch]:
+                pending.append(ex.submit(load, b))
+                next(it)
+            while pending:
+                yield pending.popleft().result()
+                nxt = next(it, None)
+                if nxt is not None:
+                    pending.append(ex.submit(load, nxt))

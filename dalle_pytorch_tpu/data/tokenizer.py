@@ -1,0 +1,217 @@
+"""Text tokenizers (pure Python / numpy — no torch, no JAX).
+
+Capability parity with the reference's three tokenizers
+(`/root/reference/dalle_pytorch/tokenizer.py`):
+
+* ``SimpleTokenizer`` — the OpenAI CLIP byte-level BPE (vocab 49408), built
+  from a merges text file.  The merges file itself is *data* we do not bundle;
+  pass ``bpe_path`` explicitly (the reference ships it at
+  ``dalle_pytorch/data/bpe_simple_vocab_16e6.txt``).
+* ``HugTokenizer`` — wraps a HuggingFace ``tokenizers`` JSON file (the fork's
+  CUB-200 BPE, ``cub200_bpe_vsize_7800.json``; ref tokenizer.py:156-190).
+* ``ChineseTokenizer`` — ``bert-base-chinese`` wordpiece (ref
+  tokenizer.py:194-225).  Gated: requires network/cache to load.
+
+Shared contract (ref tokenizer.py:135-150): ``tokenize(texts, context_length,
+truncate_text)`` returns an int32 numpy array ``[batch, context_length]``
+padded with 0; raises if a text overflows and ``truncate_text`` is False.
+"""
+from __future__ import annotations
+
+import html
+from functools import lru_cache
+from pathlib import Path
+
+import numpy as np
+import regex as re
+
+try:  # optional text fixer, matches reference behavior when present
+    import ftfy
+
+    def _fix_text(t: str) -> str:
+        return ftfy.fix_text(t)
+except ImportError:  # pragma: no cover - environment without ftfy
+    def _fix_text(t: str) -> str:
+        return t
+
+
+@lru_cache()
+def bytes_to_unicode():
+    """Reversible byte -> printable-unicode-char table (standard GPT-2/CLIP
+    byte-level BPE alphabet; ref tokenizer.py:22-33)."""
+    printable = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    chars = printable[:]
+    offset = 0
+    for b in range(256):
+        if b not in printable:
+            printable.append(b)
+            chars.append(256 + offset)
+            offset += 1
+    return dict(zip(printable, [chr(c) for c in chars]))
+
+
+def _pairs_of(word):
+    return set(zip(word[:-1], word[1:]))
+
+
+def basic_clean(text: str) -> str:
+    text = _fix_text(text)
+    text = html.unescape(html.unescape(text))
+    return text.strip()
+
+
+def whitespace_clean(text: str) -> str:
+    return re.sub(r"\s+", " ", text).strip()
+
+
+class _TokenizerBase:
+    """Shared pad/truncate batching contract (ref tokenizer.py:135-150)."""
+
+    vocab_size: int
+
+    def encode(self, text: str):  # -> list[int]
+        raise NotImplementedError
+
+    def decode(self, tokens) -> str:
+        raise NotImplementedError
+
+    def tokenize(self, texts, context_length: int = 256, truncate_text: bool = False) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        result = np.zeros((len(texts), context_length), dtype=np.int32)
+        for i, text in enumerate(texts):
+            tokens = list(self.encode(text))
+            if len(tokens) > context_length:
+                if truncate_text:
+                    tokens = tokens[:context_length]
+                else:
+                    raise RuntimeError(
+                        f"Input {texts[i]} is too long for context length {context_length}"
+                    )
+            result[i, : len(tokens)] = tokens
+        return result
+
+
+class SimpleTokenizer(_TokenizerBase):
+    """OpenAI CLIP byte-level BPE (ref tokenizer.py:53-150).
+
+    Vocab layout: 256 byte chars, 256 byte chars + ``</w>``, one token per
+    merge rule, then ``<|startoftext|>`` / ``<|endoftext|>`` -> 49408 total
+    with the standard CLIP merges file.
+    """
+
+    SOT, EOT = "<|startoftext|>", "<|endoftext|>"
+
+    def __init__(self, bpe_path: str | Path):
+        bpe_path = Path(bpe_path)
+        assert bpe_path.exists(), f"BPE merges file {bpe_path} does not exist"
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+
+        lines = bpe_path.read_text(encoding="utf8").split("\n")
+        # CLIP convention: skip header line, keep first 49152-256-2 merges.
+        merges = [tuple(m.split()) for m in lines[1 : 49152 - 256 - 2 + 1]]
+
+        vocab = list(self.byte_encoder.values())
+        vocab += [v + "</w>" for v in vocab]
+        vocab += ["".join(m) for m in merges]
+        vocab += [self.SOT, self.EOT]
+
+        self.encoder = {tok: i for i, tok in enumerate(vocab)}
+        self.decoder = {i: tok for tok, i in self.encoder.items()}
+        self.bpe_ranks = {m: i for i, m in enumerate(merges)}
+        self.vocab_size = len(vocab)
+        self._cache = {self.SOT: self.SOT, self.EOT: self.EOT}
+        self.pat = re.compile(
+            r"""<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d|[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+""",
+            re.IGNORECASE,
+        )
+
+    def _bpe(self, token: str) -> str:
+        if token in self._cache:
+            return self._cache[token]
+        word = tuple(token[:-1]) + (token[-1] + "</w>",)
+        pairs = _pairs_of(word)
+        if not pairs:
+            return token + "</w>"
+        while True:
+            bigram = min(pairs, key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if bigram not in self.bpe_ranks:
+                break
+            first, second = bigram
+            merged = []
+            i = 0
+            while i < len(word):
+                if word[i] == first and i < len(word) - 1 and word[i + 1] == second:
+                    merged.append(first + second)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = tuple(merged)
+            if len(word) == 1:
+                break
+            pairs = _pairs_of(word)
+        out = " ".join(word)
+        self._cache[token] = out
+        return out
+
+    def encode(self, text: str):
+        ids = []
+        text = whitespace_clean(basic_clean(text)).lower()
+        for token in re.findall(self.pat, text):
+            token = "".join(self.byte_encoder[b] for b in token.encode("utf-8"))
+            ids.extend(self.encoder[t] for t in self._bpe(token).split(" "))
+        return ids
+
+    def decode(self, tokens, remove_start_end: bool = True) -> str:
+        tokens = np.asarray(tokens).reshape(-1).tolist()
+        if remove_start_end:
+            special = {self.encoder[self.SOT], self.encoder[self.EOT], 0}
+            tokens = [t for t in tokens if t not in special]
+        text = "".join(self.decoder[t] for t in tokens)
+        raw = bytearray(self.byte_decoder[c] for c in text)
+        return raw.decode("utf-8", errors="replace").replace("</w>", " ")
+
+
+class HugTokenizer(_TokenizerBase):
+    """HuggingFace `tokenizers` JSON wrapper (ref tokenizer.py:156-190)."""
+
+    def __init__(self, bpe_path: str | Path):
+        from tokenizers import Tokenizer
+
+        bpe_path = Path(bpe_path)
+        assert bpe_path.exists(), f"BPE json path {bpe_path} does not exist"
+        self.tokenizer = Tokenizer.from_file(str(bpe_path))
+        self.vocab_size = self.tokenizer.get_vocab_size()
+
+    def encode(self, text: str):
+        return self.tokenizer.encode(text).ids
+
+    def decode(self, tokens) -> str:
+        tokens = np.asarray(tokens).reshape(-1).tolist()
+        tokens = [t for t in tokens if t != 0]
+        return self.tokenizer.decode(tokens, skip_special_tokens=True)
+
+
+class ChineseTokenizer(_TokenizerBase):
+    """bert-base-chinese wordpiece (ref tokenizer.py:194-225). Requires the
+    HF model to be available locally (no network in this environment)."""
+
+    def __init__(self):
+        from transformers import BertTokenizer
+
+        self.tokenizer = BertTokenizer.from_pretrained("bert-base-chinese")
+        self.vocab_size = self.tokenizer.vocab_size
+
+    def encode(self, text: str):
+        return self.tokenizer.encode(text, add_special_tokens=False)
+
+    def decode(self, tokens) -> str:
+        tokens = np.asarray(tokens).reshape(-1).tolist()
+        tokens = [t for t in tokens if t != 0]
+        return self.tokenizer.decode(tokens)

@@ -1,0 +1,136 @@
+"""CLIP — dual-encoder contrastive model (trainable) in JAX.
+
+Capability parity with the reference `CLIP`
+(`/root/reference/dalle_pytorch/dalle_pytorch.py:209-285`): text transformer
+encoder + ViT-style patch transformer encoder, masked-mean text pooling,
+L2-normalized latents, learned (exp) temperature, symmetric cross-entropy.
+
+Used both as a trainable model (`train` parity) and as the re-ranking scorer
+hook in generation (ref generate_images clip scoring :422-424, genrank.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.transformer import Transformer
+from ..utils.helpers import l2norm, masked_mean
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    """Mirrors the reference ctor kwargs (dalle_pytorch.py:209-226)."""
+
+    dim_text: int = 512
+    dim_image: int = 512
+    dim_latent: int = 512
+    num_text_tokens: int = 10000
+    text_enc_depth: int = 6
+    text_seq_len: int = 256
+    text_heads: int = 8
+    num_visual_tokens: int = 512
+    visual_enc_depth: int = 6
+    visual_heads: int = 8
+    visual_image_size: int = 256
+    visual_patch_size: int = 32
+    channels: int = 3
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        assert self.visual_image_size % self.visual_patch_size == 0, (
+            "Image dimensions must be divisible by the patch size."
+        )
+
+    @property
+    def num_patches(self) -> int:
+        return (self.visual_image_size // self.visual_patch_size) ** 2
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("dtype")
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict, **overrides) -> "CLIPConfig":
+        d = dict(d)
+        d.update(overrides)
+        return cls(**d)
+
+
+class CLIP(nn.Module):
+    cfg: CLIPConfig
+
+    def setup(self):
+        cfg = self.cfg
+        emb_init = nn.initializers.normal(1.0)
+        self.text_emb = nn.Embed(cfg.num_text_tokens, cfg.dim_text,
+                                 embedding_init=emb_init, name="text_emb")
+        self.text_pos_emb = nn.Embed(cfg.text_seq_len, cfg.dim_text,
+                                     embedding_init=emb_init, name="text_pos_emb")
+        self.text_transformer = Transformer(
+            dim=cfg.dim_text, depth=cfg.text_enc_depth, seq_len=cfg.text_seq_len,
+            causal=False, heads=cfg.text_heads, dtype=cfg.dtype,
+            name="text_transformer")
+        self.to_text_latent = nn.Dense(cfg.dim_latent, use_bias=False,
+                                       dtype=jnp.float32, name="to_text_latent")
+
+        self.to_visual_embedding = nn.Dense(cfg.dim_image, dtype=cfg.dtype,
+                                            name="to_visual_embedding")
+        self.visual_pos_emb = nn.Embed(cfg.num_patches, cfg.dim_image,
+                                       embedding_init=emb_init, name="visual_pos_emb")
+        self.visual_transformer = Transformer(
+            dim=cfg.dim_image, depth=cfg.visual_enc_depth, seq_len=cfg.num_patches,
+            causal=False, heads=cfg.visual_heads, dtype=cfg.dtype,
+            name="visual_transformer")
+        self.to_visual_latent = nn.Dense(cfg.dim_latent, use_bias=False,
+                                         dtype=jnp.float32, name="to_visual_latent")
+
+        self.temperature = self.param("temperature", nn.initializers.ones, ())
+
+    def _patchify(self, image):
+        """[b, H, W, C] -> [b, num_patches, p*p*C] (ref einops patchify :257)."""
+        p = self.cfg.visual_patch_size
+        b, H, W, C = image.shape
+        h, w = H // p, W // p
+        x = image.reshape(b, h, p, w, p, C)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(b, h * w, p * p * C)
+
+    def encode_text(self, text, mask=None):
+        emb = self.text_emb(text)
+        emb = emb + self.text_pos_emb(jnp.arange(text.shape[1]))
+        enc = self.text_transformer(emb.astype(self.cfg.dtype), mask=mask)
+        enc = enc.astype(jnp.float32)
+        if mask is not None:
+            pooled = masked_mean(enc, mask, axis=1)
+        else:
+            pooled = enc.mean(axis=1)
+        return l2norm(self.to_text_latent(pooled))
+
+    def encode_image(self, image):
+        emb = self.to_visual_embedding(self._patchify(image).astype(self.cfg.dtype))
+        emb = emb + self.visual_pos_emb(jnp.arange(emb.shape[1]))
+        enc = self.visual_transformer(emb).astype(jnp.float32)
+        return l2norm(self.to_visual_latent(enc.mean(axis=1)))
+
+    def __call__(self, text, image, text_mask=None, return_loss: bool = False):
+        text_latents = self.encode_text(text, mask=text_mask)
+        image_latents = self.encode_image(image)
+        temp = jnp.exp(self.temperature)
+
+        if not return_loss:
+            # per-pair similarity scores (ref :278-280)
+            return jnp.einsum("nd,nd->n", text_latents, image_latents) * temp
+
+        sim = jnp.einsum("id,jd->ij", text_latents, image_latents) * temp
+        b = sim.shape[0]
+        labels = jnp.arange(b)
+        logp_t = jax.nn.log_softmax(sim, axis=-1)
+        logp_i = jax.nn.log_softmax(sim.T, axis=-1)
+        ce_t = -jnp.take_along_axis(logp_t, labels[:, None], axis=1).mean()
+        ce_i = -jnp.take_along_axis(logp_i, labels[:, None], axis=1).mean()
+        return (ce_t + ce_i) / 2

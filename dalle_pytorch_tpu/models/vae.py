@@ -1,0 +1,233 @@
+"""DiscreteVAE — gumbel-softmax discrete image tokenizer, TPU-native.
+
+Capability parity with the reference `DiscreteVAE`
+(`/root/reference/dalle_pytorch/dalle_pytorch.py:54-205`), redesigned for
+XLA:TPU:
+
+* NHWC layout (XLA:TPU's preferred conv layout) instead of torch NCHW.
+* Functional flax module: explicit params, explicit RNG for the gumbel
+  sampling, no `.training` flags or in-place tensor ops.
+* Mixed precision: bf16 activations (MXU) with f32 params by default.
+
+Behavioral invariants preserved (see SURVEY.md §7):
+* encoder = num_layers x (conv k4 s2 'same-1' + relu) [+ resblocks] + 1x1 conv
+  -> num_tokens logits (ref :98-126).
+* loss = recon (MSE or huber) + kl_div_loss_weight * KL(q || uniform) where
+  the KL reduction is torch 'batchmean': summed over positions and vocab,
+  divided by batch (ref :189-200).
+* gumbel-softmax with temperature + optional hard straight-through
+  (ref :182-184).
+* `get_codebook_indices` = argmax of encoder logits, flattened row-major
+  (ref :144-149); `decode` embeds codes and runs the decoder (ref :151-161).
+* per-channel input normalization inside the model (ref :134-142).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..utils.helpers import default
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    """Hyperparameters; field names mirror the reference's ctor kwargs
+    (ref dalle_pytorch.py:69-83) so checkpoints carry identical `hparams`."""
+
+    image_size: int = 256
+    num_tokens: int = 512
+    codebook_dim: int = 512
+    num_layers: int = 3
+    num_resnet_blocks: int = 0
+    hidden_dim: int = 64
+    channels: int = 3
+    smooth_l1_loss: bool = False
+    temperature: float = 0.9
+    straight_through: bool = False
+    kl_div_loss_weight: float = 0.0
+    normalization: Optional[Tuple[Sequence[float], Sequence[float]]] = (
+        (0.5, 0.5, 0.5),
+        (0.5, 0.5, 0.5),
+    )
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        assert math.log2(self.image_size).is_integer(), "image size must be a power of 2"
+        assert self.num_layers >= 1, "number of layers must be >= 1"
+
+    @property
+    def fmap_size(self) -> int:
+        return self.image_size // (2 ** self.num_layers)
+
+    @property
+    def image_seq_len(self) -> int:
+        return self.fmap_size ** 2
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("dtype")
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict, **overrides) -> "VAEConfig":
+        d = dict(d)
+        if d.get("normalization") is not None:
+            means, stds = d["normalization"]
+            d["normalization"] = (tuple(means), tuple(stds))
+        d.update(overrides)
+        return cls(**d)
+
+
+class ResBlock(nn.Module):
+    """conv3-relu-conv3-relu-conv1 residual block (ref dalle_pytorch.py:54-66)."""
+
+    chan: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.chan, (3, 3), padding=1, dtype=self.dtype)(x)
+        h = nn.relu(h)
+        h = nn.Conv(self.chan, (3, 3), padding=1, dtype=self.dtype)(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.chan, (1, 1), dtype=self.dtype)(h)
+        return h + x
+
+
+class Encoder(nn.Module):
+    cfg: VAEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        for _ in range(cfg.num_layers):
+            x = nn.Conv(cfg.hidden_dim, (4, 4), strides=2, padding=1, dtype=cfg.dtype)(x)
+            x = nn.relu(x)
+        for _ in range(cfg.num_resnet_blocks):
+            x = ResBlock(cfg.hidden_dim, dtype=cfg.dtype)(x)
+        # 1x1 conv head to codebook logits; keep the head in f32 for a stable
+        # gumbel-softmax even when the trunk runs in bf16.
+        x = nn.Conv(cfg.num_tokens, (1, 1), dtype=jnp.float32)(x)
+        return x  # [b, h, w, num_tokens]
+
+
+class Decoder(nn.Module):
+    cfg: VAEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        has_resblocks = cfg.num_resnet_blocks > 0
+        if has_resblocks:
+            x = nn.Conv(cfg.hidden_dim, (1, 1), dtype=cfg.dtype)(x)
+            for _ in range(cfg.num_resnet_blocks):
+                x = ResBlock(cfg.hidden_dim, dtype=cfg.dtype)(x)
+        for _ in range(cfg.num_layers):
+            x = nn.ConvTranspose(cfg.hidden_dim, (4, 4), strides=(2, 2), padding="SAME", dtype=cfg.dtype)(x)
+            x = nn.relu(x)
+        x = nn.Conv(cfg.channels, (1, 1), dtype=jnp.float32)(x)
+        return x  # [b, H, W, channels]
+
+
+def gumbel_softmax(logits, key, tau, hard, axis=-1):
+    """Gumbel-softmax sample; `hard` adds the straight-through estimator
+    (equivalent of torch F.gumbel_softmax, ref dalle_pytorch.py:182)."""
+    gumbels = jax.random.gumbel(key, logits.shape, dtype=logits.dtype)
+    y_soft = jax.nn.softmax((logits + gumbels) / tau, axis=axis)
+    if not hard:
+        return y_soft
+    idx = jnp.argmax(y_soft, axis=axis)
+    y_hard = jax.nn.one_hot(idx, logits.shape[axis], axis=axis, dtype=logits.dtype)
+    return y_hard + y_soft - jax.lax.stop_gradient(y_soft)
+
+
+class DiscreteVAE(nn.Module):
+    """See module docstring. Images are NHWC float in [0, 1]."""
+
+    cfg: VAEConfig
+
+    def setup(self):
+        cfg = self.cfg
+        # N(0,1) init for parity with torch nn.Embedding (ref :94) — the
+        # codebook magnitude drives the gradient signal into the encoder.
+        self.codebook = nn.Embed(cfg.num_tokens, cfg.codebook_dim,
+                                 embedding_init=nn.initializers.normal(1.0),
+                                 name="codebook")
+        self.encoder = Encoder(cfg, name="encoder")
+        self.decoder = Decoder(cfg, name="decoder")
+
+    # ref dalle_pytorch.py:134-142
+    def norm(self, images):
+        if self.cfg.normalization is None:
+            return images
+        means, stds = self.cfg.normalization
+        means = jnp.asarray(means, images.dtype)
+        stds = jnp.asarray(stds, images.dtype)
+        return (images - means) / stds
+
+    def encode_logits(self, img):
+        """Encoder logits [b, h, w, num_tokens] (ref forward(return_logits=True))."""
+        return self.encoder(self.norm(img).astype(self.cfg.dtype))
+
+    def get_codebook_indices(self, img):
+        """Hard token ids [b, image_seq_len] (ref :144-149)."""
+        logits = self.encode_logits(img)
+        b, h, w, _ = logits.shape
+        return jnp.argmax(logits, axis=-1).reshape(b, h * w).astype(jnp.int32)
+
+    def decode(self, img_seq):
+        """Token ids [b, n] -> images [b, H, W, c] (ref :151-161)."""
+        b, n = img_seq.shape
+        h = w = int(math.isqrt(n))
+        embeds = self.codebook(img_seq).reshape(b, h, w, self.cfg.codebook_dim)
+        return self.decoder(embeds.astype(self.cfg.dtype))
+
+    def __call__(self, img, *, rng=None, return_loss=False, return_recons=False,
+                 return_logits=False, temp=None):
+        cfg = self.cfg
+        assert img.shape[1] == cfg.image_size and img.shape[2] == cfg.image_size, (
+            f"input must have the correct image size {cfg.image_size}"
+        )
+
+        logits = self.encode_logits(img)
+        if return_logits:
+            return logits
+
+        temp = default(temp, cfg.temperature)
+        if rng is None:
+            rng = self.make_rng("gumbel")
+        soft_one_hot = gumbel_softmax(logits, rng, tau=temp, hard=cfg.straight_through)
+        # [b,h,w,n] @ [n,d] -> [b,h,w,d]; large matmul, lands on the MXU.
+        sampled = jnp.einsum(
+            "bhwn,nd->bhwd", soft_one_hot,
+            self.codebook.embedding.astype(soft_one_hot.dtype),
+        )
+        out = self.decoder(sampled.astype(cfg.dtype))
+
+        if not return_loss:
+            return out
+
+        target = self.norm(img).astype(jnp.float32)
+        out_f32 = out.astype(jnp.float32)
+        if cfg.smooth_l1_loss:
+            diff = jnp.abs(out_f32 - target)
+            recon_loss = jnp.where(diff < 1.0, 0.5 * diff ** 2, diff - 0.5).mean()
+        else:
+            recon_loss = ((out_f32 - target) ** 2).mean()
+
+        # KL(q || uniform), torch-'batchmean' reduction (ref :193-198).
+        b = logits.shape[0]
+        logits_flat = logits.reshape(b, -1, cfg.num_tokens).astype(jnp.float32)
+        log_qy = jax.nn.log_softmax(logits_flat, axis=-1)
+        log_uniform = -jnp.log(float(cfg.num_tokens))
+        kl_div = (jnp.exp(log_qy) * (log_qy - log_uniform)).sum() / b
+
+        loss = recon_loss + kl_div * cfg.kl_div_loss_weight
+        if not return_recons:
+            return loss
+        return loss, out

@@ -1,0 +1,294 @@
+"""Attention variants, unified as *pattern-masked* attention.
+
+The reference implements four attention layers as separate torch modules
+(`/root/reference/dalle_pytorch/attention.py`):
+
+* ``Attention`` — full causal softmax attention (:27-66);
+* ``SparseConvCausalAttention`` — image attends all text + a causal local
+  kernel_size x kernel_size (dilated) neighborhood, via ``F.unfold``
+  (:70-176);
+* ``SparseAxialCausalAttention`` — image attends all text + causally along
+  its row (axis=0) or column (axis=1) (:180-282);
+* ``SparseAttention`` — DeepSpeed ``SparseSelfAttention`` CUDA/Triton kernel
+  with ``VariableSparsityConfig`` (block 16, local window, random blocks,
+  global text blocks, unidirectional) (:284-342).
+
+TPU-native redesign: every variant is a *boolean attention pattern* over
+absolute sequence positions.  One predicate (`_allowed`) defines each
+pattern; it is evaluated three ways:
+
+1. as a static dense [n, n] mask (numpy at trace time) for training — at the
+   reference's sequence lengths (~1104) a dense masked softmax attention is
+   already MXU-optimal, and XLA fuses the mask;
+2. as a traced single row for the KV-cache decode step inside ``lax.scan``
+   (the reference has no KV cache and reruns the full forward per token,
+   dalle_pytorch.py:400-415 — we keep output parity, not work parity);
+3. (later rounds) as a block mask feeding the Pallas flash/block-sparse
+   kernels in ``ops/attention_pallas.py``.
+
+Positions use the *padded* grid of the reference (:98-102): length
+``seq_len + 1`` where the first ``text_len = text_seq_len + 1`` positions are
+text (incl <bos>) and the rest is the ``fmap x fmap`` image raster.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.helpers import max_neg_value
+
+VARIANTS = ("full", "axial_row", "axial_col", "conv_like", "sparse")
+
+
+def make_variable_sparse_layout(
+    num_blocks: int,
+    global_blocks: int,
+    num_random_blocks: int,
+    local_window_blocks: Tuple[int, ...] = (4,),
+    causal: bool = True,
+    seed: int = 0,
+) -> np.ndarray:
+    """Block-level layout with DeepSpeed ``VariableSparsityConfig`` semantics
+    (ref attention.py:296-312): local windows, per-row random blocks, global
+    (column-attended) text blocks, optionally unidirectional.  Deterministic
+    via `seed` — the TPU analog of the kernel's fixed random layout.
+    """
+    layout = np.zeros((num_blocks, num_blocks), dtype=bool)
+
+    # local windows: consecutive row groups attend within their own group;
+    # the last window size repeats to cover the sequence.
+    sizes = list(local_window_blocks)
+    start = 0
+    i = 0
+    while start < num_blocks:
+        w = sizes[i] if i < len(sizes) else sizes[-1]
+        end = min(start + w, num_blocks)
+        layout[start:end, start:end] = True
+        start = end
+        i += 1
+
+    # random blocks: per block-row, `num_random_blocks` random block-columns
+    # (restricted to <= row when causal).
+    rng = np.random.default_rng(seed)
+    for row in range(num_blocks):
+        hi = row + 1 if causal else num_blocks
+        cols = rng.integers(0, hi, size=num_random_blocks)
+        layout[row, cols] = True
+
+    # global blocks: every row attends the global (text) block-columns.
+    layout[:, :global_blocks] = True
+
+    if causal:
+        layout &= np.tril(np.ones((num_blocks, num_blocks), dtype=bool))
+    return layout
+
+
+@dataclasses.dataclass(frozen=True)
+class AttnPattern:
+    """Static description of one layer's attention pattern."""
+
+    variant: str
+    seq_len: int          # transformer seq len (text_seq_len + image_seq_len)
+    text_len: int         # text positions incl <bos> = text_seq_len + 1
+    fmap: int             # image feature-map side; fmap**2 = image_seq_len
+    causal: bool = True   # CLIP encoders use bidirectional 'full' attention
+    kernel: int = 5       # conv_like kernel size (ref attention.py:71)
+    dilation: int = 1
+    block: int = 16       # sparse block size (ref attention.py:292)
+    num_random_blocks: Optional[int] = None
+    layout_seed: int = 0
+
+    def __post_init__(self):
+        assert self.variant in VARIANTS, f"unknown attention variant {self.variant}"
+        if self.variant == "conv_like":
+            assert self.kernel % 2 == 1, "kernel size must be odd"
+
+    @property
+    def padded_len(self) -> int:
+        return self.seq_len + 1
+
+    def block_layout(self) -> Optional[np.ndarray]:
+        if self.variant != "sparse":
+            return None
+        n = self.padded_len
+        nb = (n + self.block - 1) // self.block
+        # defaults from the reference wrapper (attention.py:299-300):
+        # random blocks = seq_len // block // 4, global blocks cover the text.
+        num_random = (
+            self.num_random_blocks
+            if self.num_random_blocks is not None
+            else self.seq_len // self.block // 4
+        )
+        global_blocks = -(-self.text_len // self.block)  # ceil
+        return make_variable_sparse_layout(
+            nb, global_blocks, num_random, causal=True, seed=self.layout_seed
+        )
+
+
+def _allowed(pattern: AttnPattern, i, j, xp, layout=None):
+    """The pattern predicate: may query position `i` attend key position `j`?
+
+    Works for both numpy (broadcast grid, static) and jnp (traced row).
+    `i`/`j` are absolute positions on the padded grid.
+    """
+    T, W = pattern.text_len, pattern.fmap
+    causal = (j <= i) if pattern.causal else (j == j)
+    v = pattern.variant
+
+    if v == "full":
+        return causal
+
+    if v == "sparse":
+        if layout is None:
+            layout = pattern.block_layout()
+        lay = xp.asarray(layout)
+        return causal & lay[i // pattern.block, j // pattern.block]
+
+    # text queries attend text causally only (ref attention.py:113-123)
+    text_q_allowed = causal & (j < T)
+
+    # image query / key raster coordinates
+    ri, ci = (i - T) // W, (i - T) % W
+    rj, cj = (j - T) // W, (j - T) % W
+
+    if v == "axial_row":
+        img_pat = (rj == ri) & (cj <= ci)
+    elif v == "axial_col":
+        img_pat = (cj == ci) & (rj <= ri)
+    elif v == "conv_like":
+        pad = ((pattern.kernel - 1) * pattern.dilation + 1) // 2
+        dr, dc = rj - ri, cj - ci
+        in_window = (
+            (xp.abs(dr) <= pad)
+            & (xp.abs(dc) <= pad)
+            & (dr % pattern.dilation == 0)
+            & (dc % pattern.dilation == 0)
+        )
+        img_pat = in_window & causal
+    else:  # pragma: no cover
+        raise ValueError(v)
+
+    img_q_allowed = xp.where(j < T, True, img_pat)
+    return xp.where(i < T, text_q_allowed, img_q_allowed)
+
+
+def dense_pattern_mask(pattern: AttnPattern, n_q: int, n_k: int) -> np.ndarray:
+    """Static [n_q, n_k] boolean mask (True = attend), built with numpy at
+    trace time so it becomes an XLA constant."""
+    i = np.arange(n_q)[:, None]
+    j = np.arange(n_k)[None, :]
+    layout = pattern.block_layout()
+    return np.asarray(_allowed(pattern, i, j, np, layout=layout))
+
+
+def pattern_mask_row(pattern: AttnPattern, index, n_k: int,
+                     layout: Optional[jax.Array] = None) -> jax.Array:
+    """Traced mask row for decode: which of the `n_k` cached keys may the
+    query at (traced) position `index` attend?"""
+    j = jnp.arange(n_k)
+    return _allowed(pattern, index, j, jnp, layout=layout)
+
+
+def _merge_key_pad_mask(pattern: AttnPattern, allow, key_mask):
+    """Apply a per-sample key padding mask [b, n_text_mask] (True = keep).
+
+    Parity: the full variant applies it to every key (attention.py:51-54);
+    sparse variants apply it to the text keys only (:99-102, :208-211).
+    `allow` is [..., n_q, n_k]; returns [b, 1, n_q, n_k]-broadcastable mask.
+    """
+    if key_mask is None:
+        return allow
+    b, m = key_mask.shape
+    n_k = allow.shape[-1]
+    if pattern.variant != "full":
+        key_mask = key_mask[:, : pattern.text_len]
+        m = key_mask.shape[1]
+    if m >= n_k:
+        pad = key_mask[:, :n_k]
+    else:
+        pad = jnp.pad(key_mask, ((0, 0), (0, n_k - m)), constant_values=True)
+    return allow & pad[:, None, None, :]
+
+
+class MultiHeadAttention(nn.Module):
+    """One attention layer of any variant (see module docstring).
+
+    Projections follow the reference shapes (`attention.py:27-41`): fused QKV
+    without bias, output projection with bias + dropout.  Softmax runs in
+    f32 regardless of the activation dtype (bf16-safe).
+    """
+
+    pattern: AttnPattern
+    dim: int = 256
+    heads: int = 8
+    dim_head: int = 64
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        inner = self.heads * self.dim_head
+        self.to_qkv = nn.Dense(inner * 3, use_bias=False, dtype=self.dtype, name="to_qkv")
+        self.to_out = nn.Dense(self.dim, use_bias=True, dtype=self.dtype, name="to_out")
+        self.drop = nn.Dropout(self.dropout)
+
+    def _qkv(self, x):
+        b, n, _ = x.shape
+        qkv = self.to_qkv(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(b, n, self.heads, self.dim_head).transpose(0, 2, 1, 3)
+        return split(q), split(k), split(v)
+
+    def __call__(self, x, mask=None, deterministic: bool = True,
+                 return_kv: bool = False):
+        b, n, _ = x.shape
+        q, k, v = self._qkv(x)
+        scale = self.dim_head ** -0.5
+
+        dots = jnp.einsum("bhid,bhjd->bhij", q * scale, k,
+                          preferred_element_type=jnp.float32)
+        allow = jnp.asarray(dense_pattern_mask(self.pattern, n, n))[None, None]
+        allow = _merge_key_pad_mask(self.pattern, allow, mask)
+        dots = jnp.where(allow, dots, max_neg_value(dots.dtype))
+        attn = jax.nn.softmax(dots, axis=-1).astype(x.dtype)
+
+        out = jnp.einsum("bhij,bhjd->bhid", attn, v)
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, self.heads * self.dim_head)
+        out = self.to_out(out)
+        out = self.drop(out, deterministic=deterministic)
+        if return_kv:
+            return out, (k, v)
+        return out
+
+    def decode_step(self, x, cache_k, cache_v, index, mask=None):
+        """Single-token decode with KV cache.
+
+        x: [b, 1, dim]; cache_k/v: [b, heads, n_cache, dim_head]; `index` is
+        the traced absolute position of this token.  Returns (out, new_k,
+        new_v).
+        """
+        b = x.shape[0]
+        q, k, v = self._qkv(x)  # [b, h, 1, dh]
+        cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
+                                               (0, 0, index, 0))
+        cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
+                                               (0, 0, index, 0))
+        n_k = cache_k.shape[2]
+        scale = self.dim_head ** -0.5
+        dots = jnp.einsum("bhid,bhjd->bhij", q * scale, cache_k,
+                          preferred_element_type=jnp.float32)
+        layout = self.pattern.block_layout()
+        row = pattern_mask_row(
+            self.pattern, index, n_k,
+            layout=jnp.asarray(layout) if layout is not None else None,
+        )[None, None, None, :]
+        row = _merge_key_pad_mask(self.pattern, row, mask)
+        dots = jnp.where(row, dots, max_neg_value(dots.dtype))
+        attn = jax.nn.softmax(dots, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhij,bhjd->bhid", attn, cache_v.astype(x.dtype))
+        out = out.transpose(0, 2, 1, 3).reshape(b, 1, self.heads * self.dim_head)
+        return self.to_out(out), cache_k, cache_v

@@ -1,0 +1,251 @@
+"""Distributed backends — registry + abstract API + Single/GSPMD backends.
+
+API parity with the reference's pluggable backend layer
+(`/root/reference/dalle_pytorch/distributed_utils.py:22-89`,
+`distributed_backends/distributed_backend.py:12-178`): the same conceptual
+surface — ``initialize / get_world_size / get_rank / get_local_rank /
+is_root_worker / is_local_root_worker / local_barrier / distribute /
+average_all / check_batch_size`` — but TPU-native underneath:
+
+* ``SingleBackend`` = the reference's DummyBackend (one process, n devices —
+  data parallelism still happens via the mesh, there's just one host).
+* ``GSPMDBackend`` = DeepSpeed/Horovod replacement.  ``initialize`` calls
+  ``jax.distributed.initialize`` (the NCCL/MPI-rendezvous analog);
+  ``distribute`` hands back a `Partitioner` (mesh + shardings) instead of
+  wrapping the model — grad allreduce becomes a `psum` XLA emits over ICI;
+  ``average_all`` is a cross-process mean for host-side metrics.
+
+"world size" counts JAX *processes* (hosts), matching the reference's rank
+semantics; device-level parallelism is the mesh's job.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .mesh import Partitioner, make_mesh
+
+
+class DistributedBackend:
+    """Abstract backend (contract of ref distributed_backend.py:12-178)."""
+
+    BACKEND_NAME = "None"
+
+    ROOT_RANK = 0
+
+    def __init__(self):
+        self._initialized = False
+
+    def has_backend(self) -> bool:
+        return True
+
+    def wrap_arg_parser(self, parser):
+        return parser
+
+    def initialize(self):
+        self._initialize()
+        self._initialized = True
+        return self
+
+    def _initialize(self):
+        raise NotImplementedError
+
+    def _require_init(self):
+        assert self._initialized, (
+            f"backend {self.BACKEND_NAME} not initialized; call initialize()"
+        )
+
+    def get_world_size(self) -> int:
+        self._require_init()
+        return self._get_world_size()
+
+    def get_rank(self) -> int:
+        self._require_init()
+        return self._get_rank()
+
+    def get_local_rank(self) -> int:
+        self._require_init()
+        return self._get_local_rank()
+
+    def is_root_worker(self) -> bool:
+        return self.get_rank() == self.ROOT_RANK
+
+    def is_local_root_worker(self) -> bool:
+        return self.get_local_rank() == self.ROOT_RANK
+
+    def in_distributed_mode(self) -> bool:
+        return self.get_world_size() > 1
+
+    def local_barrier(self):
+        raise NotImplementedError
+
+    def distribute(self, **kwargs) -> Partitioner:
+        """Return the Partitioner that owns mesh + shardings.
+
+        Where the reference's `distribute()` wraps (model, optimizer, data,
+        scheduler) into engine objects (deepspeed_backend.py:63-95), under
+        GSPMD nothing needs wrapping: the caller jits its train step with the
+        Partitioner's shardings and XLA inserts the collectives.
+        """
+        raise NotImplementedError
+
+    def average_all(self, value):
+        """Average a host-side metric across processes
+        (ref `_average_all`: NCCL all_reduce/world, deepspeed_backend.py:97-103)."""
+        raise NotImplementedError
+
+    def check_batch_size(self, batch_size: int):
+        assert batch_size >= self.get_world_size(), (
+            f"batch size {batch_size} smaller than world size {self.get_world_size()}"
+        )
+
+
+class SingleBackend(DistributedBackend):
+    """Single-process backend (ref DummyBackend, dummy_backend.py). All the
+    local devices still form a mesh — 'dummy' means one host, not one chip."""
+
+    BACKEND_NAME = "Single"
+
+    def __init__(self, mesh=None):
+        super().__init__()
+        self._mesh = mesh
+
+    def _initialize(self):
+        pass
+
+    def _get_world_size(self) -> int:
+        return 1
+
+    def _get_rank(self) -> int:
+        return 0
+
+    def _get_local_rank(self) -> int:
+        return 0
+
+    def local_barrier(self):
+        pass
+
+    def distribute(self, mesh=None, **kwargs) -> Partitioner:
+        mesh = mesh or self._mesh or make_mesh()
+        return Partitioner(mesh=mesh, **kwargs)
+
+    def average_all(self, value):
+        return value
+
+
+class GSPMDBackend(DistributedBackend):
+    """Multi-host backend over the JAX distributed runtime + GSPMD."""
+
+    BACKEND_NAME = "GSPMD"
+
+    def __init__(self, coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None,
+                 mesh=None):
+        super().__init__()
+        self.coordinator_address = coordinator_address
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self._mesh = mesh
+
+    def wrap_arg_parser(self, parser):
+        parser.add_argument("--coordinator_address", type=str, default=None,
+                            help="host:port of JAX process 0")
+        parser.add_argument("--num_processes", type=int, default=None)
+        parser.add_argument("--process_id", type=int, default=None)
+        return parser
+
+    def _initialize(self):
+        # jax.distributed.initialize is the rendezvous analog of
+        # deepspeed.init_distributed (ref deepspeed_backend.py:35-36); with no
+        # args it picks up TPU pod metadata / cluster env vars.  Must run
+        # before any other JAX call initializes the runtime.
+        kwargs = {}
+        explicit = self.coordinator_address is not None or self.num_processes is not None
+        if explicit:
+            kwargs = dict(coordinator_address=self.coordinator_address,
+                          num_processes=self.num_processes,
+                          process_id=self.process_id)
+        try:
+            jax.distributed.initialize(**kwargs)
+        except Exception:
+            if explicit:
+                raise
+            # no cluster environment detected (single process) — fine.
+
+    def _get_world_size(self) -> int:
+        return jax.process_count()
+
+    def _get_rank(self) -> int:
+        return jax.process_index()
+
+    def _get_local_rank(self) -> int:
+        # processes are 1:1 with hosts; local rank of the lead process is 0
+        return 0
+
+    def local_barrier(self):
+        # The reference barriers around rank-coordinated downloads
+        # (vae.py:67-93).  A tiny replicated psum is a full sync point.
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("dalle_pytorch_tpu_barrier")
+
+    def distribute(self, mesh=None, **kwargs) -> Partitioner:
+        mesh = mesh or self._mesh or make_mesh()
+        return Partitioner(mesh=mesh, **kwargs)
+
+    def average_all(self, value):
+        if jax.process_count() == 1:
+            return value
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(np.asarray(value))
+        return gathered.mean(axis=0)
+
+
+# --- registry (ref distributed_utils.py:22-89) ---
+
+BACKENDS = [SingleBackend, GSPMDBackend]
+
+is_distributed: Optional[bool] = None
+backend: Optional[DistributedBackend] = None
+
+
+def wrap_arg_parser(parser):
+    parser.add_argument(
+        "--distributed_backend", "--distr_backend", type=str, default=None,
+        help="which distributed backend to use (Single, GSPMD)",
+    )
+    for b in BACKENDS:
+        parser = b().wrap_arg_parser(parser)
+    return parser
+
+
+def set_backend_from_args(args) -> DistributedBackend:
+    """Select + construct the backend from CLI args (ref :48-69)."""
+    global is_distributed, backend
+    name = (getattr(args, "distributed_backend", None) or "Single").lower()
+    for b_class in BACKENDS:
+        if b_class.BACKEND_NAME.lower() == name:
+            if b_class is GSPMDBackend:
+                backend = GSPMDBackend(
+                    coordinator_address=getattr(args, "coordinator_address", None),
+                    num_processes=getattr(args, "num_processes", None),
+                    process_id=getattr(args, "process_id", None),
+                )
+            else:
+                backend = b_class()
+            is_distributed = b_class is not SingleBackend
+            return backend
+    raise ValueError(f"unknown backend {name}; choose from "
+                     f"{[b.BACKEND_NAME for b in BACKENDS]}")
+
+
+def using_backend(test_backend) -> bool:
+    """Is the selected backend an instance of `test_backend` (ref :72-89)?"""
+    assert backend is not None, "backend not selected yet"
+    if isinstance(test_backend, str):
+        return backend.BACKEND_NAME.lower() == test_backend.lower()
+    return isinstance(backend, test_backend)

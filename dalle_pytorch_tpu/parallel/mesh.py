@@ -1,0 +1,141 @@
+"""Device mesh + sharding utilities (the GSPMD heart of the framework).
+
+The reference scales with NCCL data parallelism only (SURVEY.md §2.2:
+DeepSpeed engine allreduce, Horovod DistributedOptimizer).  TPU-natively all
+of that collapses into: build a `jax.sharding.Mesh`, annotate shardings, and
+let XLA insert the collectives over ICI/DCN.  This module owns:
+
+* mesh construction with named axes ``('dp', 'fsdp', 'tp')`` — data,
+  fully-sharded-data (ZeRO-ish), tensor parallel;
+* regex partition rules mapping flax param paths -> `PartitionSpec` (pattern
+  after dalle-mini-style partitioning, see SNIPPETS.md [1]);
+* global batch construction from per-process host arrays
+  (`jax.make_array_from_process_local_data`) — the analog of torch's
+  ``DistributedSampler`` + ``.cuda()`` H2D step.
+"""
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Default partition rules for our models' flax param trees.  Matched against
+# the '/'-joined param path; first hit wins; default = replicated.
+# Dense kernels are [d_in, d_out]; embeddings are [vocab, dim].
+DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
+    # column-parallel projections (split output features over tp)
+    (r".*(to_qkv|to_q|to_k|to_v)/kernel$", P("fsdp", "tp")),
+    (r".*ff/dense_in/kernel$", P("fsdp", "tp")),
+    # row-parallel projections (split input features over tp)
+    (r".*to_out/kernel$", P("tp", "fsdp")),
+    (r".*ff/dense_out/kernel$", P("tp", "fsdp")),
+    # token embeddings / logits head: shard the vocab dim over tp
+    (r".*(text_emb|image_emb)/embedding$", P("tp", "fsdp")),
+    (r".*to_logits_dense/kernel$", P("fsdp", "tp")),
+    # conv kernels (VAE): shard output channels over fsdp only
+    (r".*codebook/embedding$", P(None, "fsdp")),
+    (r".*/kernel$", P(None, None)),
+)
+
+
+def make_mesh(dp: Optional[int] = None, fsdp: int = 1, tp: int = 1,
+              devices=None) -> Mesh:
+    """Build a ('dp','fsdp','tp') mesh.  `dp=None` absorbs remaining devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if dp is None:
+        assert n % (fsdp * tp) == 0, f"{n} devices not divisible by fsdp*tp={fsdp * tp}"
+        dp = n // (fsdp * tp)
+    assert dp * fsdp * tp == n, f"mesh {dp}x{fsdp}x{tp} != {n} devices"
+    dev_array = np.asarray(devices).reshape(dp, fsdp, tp)
+    return Mesh(dev_array, ("dp", "fsdp", "tp"))
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        parts.append(str(key) if key is not None else str(p))
+    return "/".join(parts)
+
+
+def _prune_spec(spec: P, mesh: Mesh, shape) -> P:
+    """Drop axes of size 1 and axes that don't divide the dim — keeps rules
+    valid on any mesh (e.g. pure-dp) without per-mesh rule sets."""
+    out = []
+    for dim, names in enumerate(spec):
+        if names is None:
+            out.append(None)
+            continue
+        names_t = (names,) if isinstance(names, str) else tuple(names)
+        size = 1
+        for nm in names_t:
+            size *= mesh.shape[nm]
+        if size == 1 or dim >= len(shape) or shape[dim] % size != 0:
+            out.append(None)
+        else:
+            out.append(names if isinstance(names, str) else names_t)
+    return P(*out)
+
+
+class Partitioner:
+    """Owns the mesh + param/batch shardings for a training run."""
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 rules: Sequence[Tuple[str, P]] = DEFAULT_RULES,
+                 batch_axes=("dp", "fsdp")):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.batch_axes = tuple(batch_axes)
+        self.batch_spec = P(self.batch_axes)
+        self.data_sharding = NamedSharding(self.mesh, self.batch_spec)
+        self.repl_sharding = NamedSharding(self.mesh, P())
+
+    def spec_for(self, path, value) -> P:
+        s = _path_str(path)
+        for pat, spec in self.rules:
+            if pat.match(s):
+                return _prune_spec(spec, self.mesh, value.shape)
+        return P()
+
+    def param_specs(self, params):
+        return jax.tree_util.tree_map_with_path(
+            lambda p, v: self.spec_for(p, v), params
+        )
+
+    def param_shardings(self, params):
+        return jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            self.param_specs(params),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def shard_params(self, params):
+        return jax.device_put(params, self.param_shardings(params))
+
+    def replicate(self, tree):
+        return jax.device_put(tree, self.repl_sharding)
+
+    def shard_batch(self, batch):
+        """Per-process numpy batch -> globally sharded jax.Array.
+
+        Under multi-process JAX each host holds its shard of the global batch
+        (the DataLoader already gives disjoint slices);
+        `make_array_from_process_local_data` assembles the logical global
+        array over ICI/DCN without any host gather.
+        """
+        batch_size = 1
+        for nm in self.batch_axes:
+            batch_size *= self.mesh.shape[nm]
+
+        def _shard(x):
+            x = np.asarray(x)
+            global_rows = x.shape[0] * jax.process_count()
+            axes = self.batch_axes if global_rows % batch_size == 0 else None
+            sharding = NamedSharding(self.mesh, P(axes, *([None] * (x.ndim - 1))))
+            return jax.make_array_from_process_local_data(sharding, x)
+
+        return jax.tree.map(_shard, batch)

@@ -1,0 +1,105 @@
+"""Jit-compiled train steps for DiscreteVAE and DALLE.
+
+The reference's training loop shape (forward -> backward -> allreduce ->
+step, `train_vae.py:165-236`, `train_dalle.py:357-416`) collapses on TPU
+into a single jitted function per model: loss + grads + optimizer update in
+one XLA program, with gradient all-reduce inserted by GSPMD from the input
+shardings.  Optimizer is optax Adam wrapped in ``inject_hyperparams`` so the
+host-side schedules (utils/schedule.py) can set the lr between steps without
+retracing — replacing torch's stateful ``ExponentialLR`` /
+``ReduceLROnPlateau`` and the DeepSpeed engine's fused step.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _adam_chain(learning_rate, grad_clip_norm=0.0):
+    steps = []
+    if grad_clip_norm and float(grad_clip_norm) > 0:
+        steps.append(optax.clip_by_global_norm(float(grad_clip_norm)))
+    steps.append(optax.adam(learning_rate=learning_rate))
+    return optax.chain(*steps)
+
+
+def make_optimizer(learning_rate: float, grad_clip_norm: float = 0.0):
+    """Adam, matching the reference's torch.optim.Adam defaults
+    (train_dalle.py:284, train_vae.py:123), with optional global-norm clip
+    (train_dalle.py:371-372).  The lr is an injected hyperparam so host-side
+    schedules can change it without retracing."""
+    return optax.inject_hyperparams(_adam_chain, static_args=("grad_clip_norm",))(
+        learning_rate=learning_rate, grad_clip_norm=grad_clip_norm)
+
+
+def set_learning_rate(opt_state, lr: float):
+    """Host-side lr override for the next steps (plateau/exp schedules)."""
+    opt_state.hyperparams["learning_rate"] = jnp.asarray(lr, dtype=jnp.float32)
+    return opt_state
+
+
+def make_vae_train_step(vae, tx, donate: bool = True):
+    """(params, opt_state, images, rng, temp) -> (params, opt_state, loss, recons).
+
+    `temp` is a traced scalar so the gumbel temperature anneal
+    (train_vae.py:211-217) never retraces.
+    """
+
+    def train_step(params, opt_state, images, rng, temp):
+        def loss_fn(p):
+            loss, recons = vae.apply(
+                {"params": p}, images, rng=rng, return_loss=True,
+                return_recons=True, temp=temp)
+            return loss, recons
+
+        (loss, recons), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, recons
+
+    return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True):
+    """DALLE step.  If `vae` is given, batches carry raw images and the
+    (frozen) VAE encodes them to codes inside the step, mirroring the
+    reference's in-forward `vae.get_codebook_indices` under no_grad
+    (dalle_pytorch.py:459, :144-149); otherwise batches carry codes.
+    """
+
+    def train_step(params, opt_state, vae_params, text, images_or_codes, rng):
+        if vae is not None:
+            codes = vae.apply({"params": vae_params}, images_or_codes,
+                              method=type(vae).get_codebook_indices)
+            codes = jax.lax.stop_gradient(codes)
+        else:
+            codes = images_or_codes
+
+        def loss_fn(p):
+            return dalle.apply({"params": p}, text, codes, return_loss=True,
+                               deterministic=False,
+                               rngs={"dropout": rng})
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_clip_train_step(clip, tx, donate: bool = True):
+    def train_step(params, opt_state, text, images, text_mask):
+        def loss_fn(p):
+            return clip.apply({"params": p}, text, images, text_mask=text_mask,
+                              return_loss=True)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,49 @@
+"""Checkpoint save/load.
+
+Format parity with the reference (`train_dalle.py:174-184`,
+`train_vae.py:110-119`): a single file holding a dict with keys
+``hparams`` / ``vae_params`` / ``weights`` (and, fixing the reference's gap
+noted in SURVEY.md §5.3, optionally ``opt_state`` + ``step`` so training can
+resume exactly).  Serialized with flax msgpack instead of torch pickles —
+single-writer (process 0) semantics.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def _to_numpy(tree):
+    return jax.tree.map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, tree
+    )
+
+
+def save_checkpoint(path: str | Path, obj: dict) -> None:
+    """Atomically write `obj` (a pytree of arrays + plain python) to `path`."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    data = serialization.msgpack_serialize(_to_numpy(obj))
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=path.name, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_checkpoint(path: str | Path) -> Any:
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def is_process_zero() -> bool:
+    return jax.process_index() == 0

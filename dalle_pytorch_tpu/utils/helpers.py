@@ -1,0 +1,59 @@
+"""Small shared helpers.
+
+TPU-native analog of the helper block in the reference
+(`/root/reference/dalle_pytorch/dalle_pytorch.py:13-50`), re-expressed for a
+functional JAX codebase: no in-place ops, no `.training` flags, explicit RNG.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def exists(val):
+    return val is not None
+
+
+def default(val, d):
+    if val is not None:
+        return val
+    return d() if callable(d) else d
+
+
+def cast_tuple(val, depth: int = 1):
+    if isinstance(val, list):
+        val = tuple(val)
+    return val if isinstance(val, tuple) else (val,) * depth
+
+
+def max_neg_value(dtype) -> float:
+    """Most-negative finite value for a dtype (ref dalle_pytorch.py:483)."""
+    return -jnp.finfo(dtype).max
+
+
+def masked_mean(t: jax.Array, mask: jax.Array, axis: int = 1) -> jax.Array:
+    """Mean over `axis` counting only positions where `mask` is True.
+
+    Ref `dalle_pytorch.py:29-31` (CLIP text pooling).
+    """
+    mask = mask[..., None]
+    t = jnp.where(mask, t, 0.0)
+    return t.sum(axis=axis) / mask.sum(axis=axis)
+
+
+def l2norm(t: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
+    return t / jnp.maximum(jnp.linalg.norm(t, axis=axis, keepdims=True), eps)
+
+
+def top_k_filter(logits: jax.Array, thres: float = 0.5) -> jax.Array:
+    """Keep the top `max(int((1-thres)*V), 1)` logits, set the rest to -inf.
+
+    Exact semantics of the reference sampler filter
+    (`dalle_pytorch.py:44-50`): k is derived from the vocab size, not given
+    directly. Static `k` keeps this jit-friendly.
+    """
+    num_logits = logits.shape[-1]
+    k = max(int((1 - thres) * num_logits), 1)
+    vals, _ = jax.lax.top_k(logits, k)
+    kth = vals[..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)

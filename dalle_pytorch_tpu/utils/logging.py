@@ -1,0 +1,67 @@
+"""Training logging: plain-text step log + optional wandb.
+
+Reproduces the reference's observability surface (SURVEY.md §5.5):
+* a text log file with one ``epoch iter loss lr`` line per step
+  (`train_dalle.py:351-353, :378`) — these are the ``all-logs/*.txt``
+  artifacts the fork's analysis notebook consumes;
+* wandb scalars/images when wandb is installed (root process only);
+* stdout prints every `print_every` iters (`train_dalle.py:383`).
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import Optional
+
+import jax
+
+try:
+    import wandb as _wandb
+except ImportError:  # environment without wandb — log to text/stdout only
+    _wandb = None
+
+
+class TrainLogger:
+    def __init__(self, log_filename: Optional[str] = None, project: Optional[str] = None,
+                 config: Optional[dict] = None, print_every: int = 10,
+                 use_wandb: bool = True):
+        self.is_root = jax.process_index() == 0
+        self.print_every = print_every
+        self.run = None
+        self._f = None
+        if self.is_root and use_wandb and _wandb is not None and project is not None:
+            self.run = _wandb.init(project=project, config=config or {})
+            log_filename = log_filename or f"{self.run.name}.txt"
+        if log_filename is not None:
+            Path(log_filename).parent.mkdir(parents=True, exist_ok=True)
+            self._f = open(log_filename, "a+")
+        self.log_filename = log_filename
+
+    @property
+    def run_name(self) -> str:
+        return self.run.name if self.run is not None else "local-run"
+
+    def step(self, epoch: int, it: int, loss: float, lr: float, extra: Optional[dict] = None):
+        if self._f is not None:
+            self._f.write(f"{epoch} {it} {loss} {lr}\n")
+        if not self.is_root:
+            return
+        if it % self.print_every == 0:
+            print(epoch, it, f"loss - {loss}")
+            sys.stdout.flush()
+            if self._f is not None:  # flush cadence of the reference (:393-394)
+                self._f.flush()
+            if self.run is not None:
+                payload = {"epoch": epoch, "iter": it, "loss": loss, "lr": lr}
+                payload.update(extra or {})
+                self.run.log(payload)
+
+    def log(self, payload: dict):
+        if self.is_root and self.run is not None:
+            self.run.log(payload)
+
+    def finish(self):
+        if self._f is not None:
+            self._f.close()
+        if self.run is not None:
+            self.run.finish()

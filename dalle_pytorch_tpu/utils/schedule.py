@@ -1,0 +1,91 @@
+"""Host-side learning-rate schedules.
+
+The reference relies on two stateful torch schedulers:
+* ``ExponentialLR`` gamma=0.98 for the VAE (`train_vae.py:124`), stepped every
+  100 iters alongside the gumbel temperature anneal (`train_vae.py:211-217`).
+* ``ReduceLROnPlateau`` (factor 0.5, patience 5, cooldown 0, min 1e-7) for
+  DALLE (`train_dalle.py:286-295`), stepped on the epoch-end loss.
+
+Both are inherently host-side, loss-driven state machines; in JAX the jitted
+train step takes the current lr as a scalar input (via
+``optax.inject_hyperparams``), and these classes own the state on the host.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class ExponentialDecay:
+    lr: float
+    gamma: float = 0.98
+
+    def step(self) -> float:
+        self.lr *= self.gamma
+        return self.lr
+
+
+@dataclasses.dataclass
+class ReduceLROnPlateau:
+    """min-mode plateau scheduler, semantics of torch.optim.lr_scheduler's
+    (threshold 1e-4 rel, as torch defaults; ref train_dalle.py:286-295)."""
+
+    lr: float
+    factor: float = 0.5
+    patience: int = 5
+    cooldown: int = 0
+    min_lr: float = 1e-7
+    threshold: float = 1e-4
+
+    best: float = float("inf")
+    num_bad_epochs: int = 0
+    cooldown_counter: int = 0
+
+    def step(self, metric: float) -> float:
+        if metric < self.best * (1.0 - self.threshold):
+            self.best = metric
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad_epochs = 0
+
+        if self.num_bad_epochs > self.patience:
+            self.lr = max(self.lr * self.factor, self.min_lr)
+            self.cooldown_counter = self.cooldown
+            self.num_bad_epochs = 0
+        return self.lr
+
+    def state_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def load_state_dict(self, d: dict) -> None:
+        for k, v in d.items():
+            setattr(self, k, v)
+
+
+@dataclasses.dataclass
+class GumbelTemperature:
+    """VAE gumbel temperature anneal: ``temp * exp(-anneal_rate * step)``
+    floored at `min_temp`, updated every 100 steps (ref train_vae.py:55-57,
+    :211-217)."""
+
+    start: float = 1.0
+    min_temp: float = 0.5
+    anneal_rate: float = 1e-6
+    value: float = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.value is None:
+            self.value = self.start
+
+    def update(self, global_step: int) -> float:
+        import math
+
+        # compounding, as the reference applies it repeatedly
+        # (temp = max(temp * exp(-rate * global_step), min); train_vae.py:213)
+        self.value = max(self.value * math.exp(-self.anneal_rate * global_step),
+                         self.min_temp)
+        return self.value

@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The TPU-native analog of "multi-node testing without a cluster" (SURVEY.md
+§4): all distributed/sharding tests run on 8 virtual CPU devices via
+``--xla_force_host_platform_device_count`` — the real TPU is only used by
+bench.py.  Must run before any backend is initialized; the axon TPU plugin
+registered in sitecustomize is overridden via jax.config.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

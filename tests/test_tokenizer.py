@@ -1,0 +1,80 @@
+"""Tokenizer tests: CLIP BPE round-trip, pad/truncate contract, HF JSON
+wrapper (SURVEY.md §4: 'tokenizer round-trip').
+
+The BPE merges/vocab files are *data* artifacts the reference ships
+(`dalle_pytorch/data/bpe_simple_vocab_16e6.txt`, `cub200_bpe_vsize_7800.json`)
+— we don't bundle them; tests use them read-only from the reference checkout
+when present and otherwise exercise a synthetic merges file.
+"""
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.data.tokenizer import (
+    HugTokenizer, SimpleTokenizer, bytes_to_unicode)
+
+REF_BPE = Path("/root/reference/dalle_pytorch/data/bpe_simple_vocab_16e6.txt")
+REF_CUB = Path("/root/reference/cub200_bpe_vsize_7800.json")
+
+
+def test_bytes_to_unicode_bijective():
+    table = bytes_to_unicode()
+    assert len(table) == 256
+    assert len(set(table.values())) == 256
+
+
+@pytest.fixture(scope="module")
+def synthetic_bpe(tmp_path_factory):
+    """Tiny merges file in the CLIP format: header line then merge pairs."""
+    d = tmp_path_factory.mktemp("bpe")
+    p = d / "merges.txt"
+    merges = ["#version: synthetic", "h e", "l l", "he ll", "hell o</w>",
+              "w o", "r l", "wo rl", "worl d</w>"]
+    p.write_text("\n".join(merges) + "\n")
+    return p
+
+
+def test_simple_tokenizer_synthetic_roundtrip(synthetic_bpe):
+    tok = SimpleTokenizer(synthetic_bpe)
+    ids = tok.encode("hello world")
+    assert len(ids) > 0
+    assert tok.decode(ids).strip() == "hello world"
+
+
+def test_pad_and_truncate_contract(synthetic_bpe):
+    tok = SimpleTokenizer(synthetic_bpe)
+    out = tok.tokenize(["hello", "hello world"], context_length=16)
+    assert out.shape == (2, 16) and out.dtype == np.int32
+    n1 = len(tok.encode("hello"))
+    assert (out[0, n1:] == 0).all()  # pad with 0 (ref tokenizer.py:140)
+
+    with pytest.raises(RuntimeError):
+        tok.tokenize("hello world hello world hello world", context_length=2)
+    t = tok.tokenize("hello world hello world", context_length=2,
+                     truncate_text=True)
+    assert t.shape == (1, 2)
+
+
+@pytest.mark.skipif(not REF_BPE.exists(), reason="reference BPE data not present")
+def test_clip_bpe_real_vocab():
+    tok = SimpleTokenizer(REF_BPE)
+    assert tok.vocab_size == 49408  # ref tokenizer.py:66
+    ids = tok.encode("a photo of a small bird with white belly")
+    assert all(0 <= i < 49408 for i in ids)
+    assert tok.decode(ids).strip() == "a photo of a small bird with white belly"
+    # whitespace/case normalization
+    assert tok.encode("  A   Photo ") == tok.encode("a photo")
+
+
+@pytest.mark.skipif(not REF_CUB.exists(), reason="CUB BPE json not present")
+def test_hug_tokenizer_cub():
+    tok = HugTokenizer(REF_CUB)
+    assert tok.vocab_size == 7800 or tok.vocab_size > 7000
+    ids = tok.encode("this bird has a yellow crown and black wings")
+    out = tok.tokenize("this bird has a yellow crown and black wings",
+                       context_length=80)
+    assert out.shape == (1, 80)
+    assert (out[0, : len(ids)] == np.asarray(ids)).all()
+    decoded = tok.decode(out[0])
+    assert "bird" in decoded

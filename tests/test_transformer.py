@@ -1,0 +1,112 @@
+"""Transformer stack tests: reversible executor gradient equivalence,
+remat equivalence, LayerScale init staging (SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu.ops.reversible import (
+    reversible_sequence, reversible_sequence_naive)
+from dalle_pytorch_tpu.ops.transformer import Transformer, layerscale_init
+
+
+def test_layerscale_init_staging():
+    """ref transformer.py:28-42."""
+    assert layerscale_init(1) == 0.1
+    assert layerscale_init(18) == 0.1
+    assert layerscale_init(19) == 1e-5
+    assert layerscale_init(24) == 1e-5
+    assert layerscale_init(25) == 1e-6
+
+
+def _build(reversible, use_remat=False, depth=3):
+    tf = Transformer(dim=32, depth=depth, seq_len=20, causal=True, heads=2,
+                     dim_head=8, attn_types=("full",), reversible=reversible,
+                     use_remat=use_remat)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (2, 20, 32))
+    params = tf.init(rng, x)
+    return tf, params, x
+
+
+def test_remat_matches_plain():
+    tf_a, params, x = _build(False)
+    tf_b = Transformer(dim=32, depth=3, seq_len=20, causal=True, heads=2,
+                       dim_head=8, attn_types=("full",), use_remat=True)
+    out_a = tf_a.apply(params, x)
+    out_b = tf_b.apply(params, x)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), atol=1e-6)
+
+    ga = jax.grad(lambda p: (tf_a.apply(p, x) ** 2).sum())(params)
+    gb = jax.grad(lambda p: (tf_b.apply(p, x) ** 2).sum())(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-5), ga, gb)
+
+
+def test_reversible_custom_vjp_grad_equivalence():
+    """O(1)-memory custom_vjp backward must produce the same gradients as
+    plain autodiff through the identical two-stream forward (the analog of
+    the reference's reversible-vs-stored-activation equivalence,
+    reversible.py:70-124)."""
+    tf, params, x = _build(True)
+
+    def loss_custom(p):
+        return (tf.apply(p, x) ** 2).sum()
+
+    # plain-autodiff twin: same params — an all-True key mask is a no-op on
+    # the math but routes the reversible path to the naive executor
+    mask = jnp.ones((2, 20), bool)
+
+    def loss_naive(p):
+        return (tf.apply(p, x, mask) ** 2).sum()
+
+    l1, g1 = jax.value_and_grad(loss_custom)(params)
+    l2, g2 = jax.value_and_grad(loss_naive)(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5), g1, g2)
+
+
+def test_reversible_executor_primitives():
+    """reversible_sequence == naive forward, and grads match, on plain
+    function blocks."""
+    rng = np.random.default_rng(0)
+    W1 = jnp.asarray(rng.normal(size=(8, 8)) * 0.1)
+    W2 = jnp.asarray(rng.normal(size=(8, 8)) * 0.1)
+
+    def f(p, x):
+        return jnp.tanh(x @ p)
+
+    f_fns = (f, f)
+    g_fns = (f, f)
+    f_params = (W1, W2)
+    g_params = (W2, W1)
+    x = jnp.asarray(rng.normal(size=(4, 8)))
+
+    out_fast = reversible_sequence(f_fns, g_fns, f_params, g_params, x, x)
+    out_naive = reversible_sequence_naive(f_fns, g_fns, f_params, g_params, x, x)
+    np.testing.assert_allclose(np.asarray(out_fast[0]), np.asarray(out_naive[0]),
+                               atol=1e-6)
+
+    def loss(exec_fn, fp, gp):
+        y1, y2 = exec_fn(f_fns, g_fns, fp, gp, x, x)
+        return ((y1 + y2) ** 2).sum()
+
+    g_fast = jax.grad(lambda fp, gp: loss(reversible_sequence, fp, gp),
+                      argnums=(0, 1))(f_params, g_params)
+    g_naive = jax.grad(lambda fp, gp: loss(reversible_sequence_naive, fp, gp),
+                       argnums=(0, 1))(f_params, g_params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g_fast, g_naive)
+
+
+def test_attn_type_cycling():
+    """attn_types cycle over depth (ref transformer.py:93-109)."""
+    tf = Transformer(dim=16, depth=5, seq_len=20, causal=True, heads=2,
+                     dim_head=8, attn_types=("full", "axial_row"),
+                     image_fmap_size=4, text_len=5)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (1, 20, 16))
+    params = tf.init(rng, x)
+    bound = tf.bind(params)
+    variants = [b.pattern.variant for b in bound.attn_blocks]
+    assert variants == ["full", "axial_row", "full", "axial_row", "full"]
