@@ -1,0 +1,75 @@
+"""Benchmark: DALLE CUB-200 train-step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config matches the reference's CUB-200 run (ref train_dalle.py:74-97): dim
+256, depth 8, heads 8, d_head 64, text_seq 80, image fmap 32 (8192-token
+VAE), attn cycle full/axial_row/axial_col/conv_like, batch 16 — the setup
+whose loss curves are the repo's only committed perf artifact
+(all-logs/cool-frog-21.txt, BASELINE.md).  The reference publishes no
+throughput numbers ("published": {} in BASELINE.json), so vs_baseline is
+null.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def main():
+    from dalle_pytorch_tpu import DALLE, DALLEConfig
+    from dalle_pytorch_tpu.training import make_optimizer
+
+    cfg = DALLEConfig(
+        dim=256, num_text_tokens=7800, text_seq_len=80, depth=8, heads=8,
+        dim_head=64, attn_types=("full", "axial_row", "axial_col", "conv_like"),
+        num_image_tokens=8192, image_size=256, image_fmap_size=32,
+        dtype=jnp.bfloat16,
+    )
+    model = DALLE(cfg)
+    batch = 16
+
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (batch, cfg.text_seq_len), 0, cfg.num_text_tokens)
+    codes = jax.random.randint(rng, (batch, cfg.image_seq_len), 0, cfg.num_image_tokens)
+    params = jax.jit(lambda r: model.init(r, text[:1], codes[:1])["params"])(rng)
+    tx = make_optimizer(3e-4)
+    opt_state = jax.jit(tx.init)(params)
+
+    @jax.jit
+    def train_step(params, opt_state, text, codes):
+        def loss_fn(p):
+            return model.apply({"params": p}, text, codes, return_loss=True)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # warmup (compile + 2 steady steps)
+    for _ in range(3):
+        params, opt_state, loss = train_step(params, opt_state, text, codes)
+    loss.block_until_ready()
+
+    steps = 100
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, text, codes)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "dalle_cub200_train_throughput",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,8 @@ def _load_image(path: Path):
     from PIL import Image
 
     img = Image.open(path)
+    img.load()  # force the decode now — PIL is lazy, and the dataset's
+    # skip-bad-sample handler must see truncated-file errors here
     if img.mode != "RGB":
         img = img.convert("RGB")
     return img
@@ -126,18 +128,31 @@ class TextImageDataset:
             draw = self._counter
         rng = np.random.default_rng((self.seed, idx, draw))
 
-        key = self.keys[idx]
-        descriptions = [
-            line for line in self.text_files[key].read_text().split("\n") if line
-        ]
-        description = descriptions[int(rng.integers(len(descriptions)))]
-        tokens = self.tokenizer.tokenize(
-            description, self.text_len, truncate_text=self.truncate_captions
-        )[0]
-        img = _load_image(self.image_files[key])
-        img = random_resized_crop(img, self.image_size, rng,
-                                  scale=(self.resize_ratio, 1.0))
-        return tokens, _to_float_array(img)
+        # skip-bad-sample resilience: walk to a neighboring index rather than
+        # aborting the epoch on one corrupt image / empty caption.
+        max_attempts = min(len(self), 16)
+        for attempt in range(max_attempts):
+            key = self.keys[(idx + attempt) % len(self)]
+            try:
+                descriptions = [
+                    line for line in self.text_files[key].read_text().split("\n")
+                    if line.strip()
+                ]
+                if not descriptions:
+                    raise ValueError(f"empty caption file {self.text_files[key]}")
+                description = descriptions[int(rng.integers(len(descriptions)))]
+                tokens = self.tokenizer.tokenize(
+                    description, self.text_len, truncate_text=self.truncate_captions
+                )[0]
+                img = _load_image(self.image_files[key])
+                img = random_resized_crop(img, self.image_size, rng,
+                                          scale=(self.resize_ratio, 1.0))
+                return tokens, _to_float_array(img)
+            except (OSError, ValueError) as e:
+                print(f"warning: skipping sample {key}: {e}", flush=True)
+        raise RuntimeError(
+            f"TextImageDataset: {max_attempts} consecutive samples failed to "
+            f"load starting at index {idx} — check the dataset folder")
 
 
 class DataLoader:

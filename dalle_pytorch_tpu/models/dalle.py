@@ -157,16 +157,21 @@ class DALLE(nn.Module):
 
     # --- embedding helpers ---
 
+    def _remap_pad_tokens(self, text):
+        """Pad id 0 at text position t -> unique id num_text_tokens + t
+        (ref :315, :440-441)."""
+        cfg = self.cfg
+        text_range = jnp.arange(cfg.text_seq_len) + (
+            cfg.total_text_tokens - cfg.text_seq_len)
+        return jnp.where(text == 0, text_range, text)
+
     def _embed_text(self, text):
         """Unique-pad remap + <bos> + token/pos embeddings (ref :440-448)."""
         cfg = self.cfg
         assert text.shape[-1] == cfg.text_seq_len, (
             f"text length {text.shape[-1]} != text_seq_len {cfg.text_seq_len}"
         )
-        text_range = jnp.arange(cfg.text_seq_len) + (
-            cfg.total_text_tokens - cfg.text_seq_len)
-        text = jnp.where(text == 0, text_range, text)
-        text = jnp.pad(text, ((0, 0), (1, 0)))  # <bos> id 0
+        text = jnp.pad(self._remap_pad_tokens(text), ((0, 0), (1, 0)))  # <bos> id 0
         tokens = self.text_emb(text)
         tokens = tokens + self.text_pos_emb(jnp.arange(text.shape[1]))
         return tokens.astype(cfg.dtype)
@@ -225,11 +230,9 @@ class DALLE(nn.Module):
 
         assert image_codes is not None, "when training, image codes must be supplied"
         # labels: next-token over [text[1:], offset image codes] (ref :489-499)
-        text_range = jnp.arange(cfg.text_seq_len) + (
-            cfg.total_text_tokens - cfg.text_seq_len)
-        text_remapped = jnp.where(text == 0, text_range, text)
         labels = jnp.concatenate(
-            [text_remapped, image_codes + cfg.total_text_tokens], axis=1)
+            [self._remap_pad_tokens(text), image_codes + cfg.total_text_tokens],
+            axis=1)
 
         logprobs = jax.nn.log_softmax(logits, axis=-1)
         token_ll = jnp.take_along_axis(logprobs, labels[:, :, None], axis=-1)[..., 0]

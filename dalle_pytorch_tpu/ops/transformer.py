@@ -124,6 +124,7 @@ class Transformer(nn.Module):
     image_fmap_size: Optional[int] = None
     text_len: Optional[int] = None     # text positions incl <bos>
     reversible: bool = False
+    reversible_naive: bool = False  # test hook: plain-autodiff two-stream
     use_remat: bool = False
     sparse_layout_seed: int = 0
     dtype: Any = jnp.float32
@@ -188,20 +189,26 @@ class Transformer(nn.Module):
         """Two-stream reversible executor (ref reversible.py:143-157):
         duplicate the channels, run y1 = x1 + f(x2); y2 = x2 + g(y1), output
         the mean of both streams.  O(1) activation memory via custom_vjp."""
+        # custom_vjp functions cannot close over traced values, so a (traced)
+        # padding mask rides inside the differentiable f-params pytree as a
+        # float leaf (where() grads wrt the condition are zero; the cotangent
+        # is computed and discarded).
+        mask_f = None if mask is None else mask.astype(jnp.float32)
         f_fns, f_params, g_fns, g_params = [], [], [], []
         for attn, ff in zip(self.attn_blocks, self.ff_blocks):
             unbound_attn, attn_vars = attn.unbind()
             unbound_ff, ff_vars = ff.unbind()
 
             def f_fn(p, h, m=unbound_attn):
-                return m.apply({"params": p}, h, mask=mask,
+                key_mask = None if p.get("mask") is None else p["mask"] > 0.5
+                return m.apply({"params": p["params"]}, h, mask=key_mask,
                                deterministic=deterministic)
 
             def g_fn(p, h, m=unbound_ff):
                 return m.apply({"params": p}, h, deterministic=deterministic)
 
             f_fns.append(f_fn)
-            f_params.append(attn_vars["params"])
+            f_params.append({"params": attn_vars["params"], "mask": mask_f})
             g_fns.append(g_fn)
             g_params.append(ff_vars["params"])
 
@@ -221,10 +228,8 @@ class Transformer(nn.Module):
                 x1 = x1 + h
                 x2 = x2 + ff(x1, deterministic=deterministic)
             return (x1 + x2) / 2, kvs
-        # custom_vjp functions cannot close over traced values; with a traced
-        # `mask` (generation prefill — no grads needed) run the same math
-        # under plain autodiff.
-        executor = reversible_sequence if mask is None else reversible_sequence_naive
+        executor = (reversible_sequence_naive if self.reversible_naive
+                    else reversible_sequence)
         y1, y2 = executor(
             tuple(f_fns), tuple(g_fns), tuple(f_params), tuple(g_params), x, x
         )

@@ -170,10 +170,20 @@ class GSPMDBackend(DistributedBackend):
                           process_id=self.process_id)
         try:
             jax.distributed.initialize(**kwargs)
-        except Exception:
+        except Exception as e:
             if explicit:
                 raise
-            # no cluster environment detected (single process) — fine.
+            # No cluster environment detected — running single-process.  Warn
+            # loudly: if the user expected a pod, silently degrading to
+            # world_size=1 would train N independent model copies.
+            import warnings
+
+            warnings.warn(
+                f"GSPMDBackend: jax.distributed.initialize failed ({e!r}); "
+                "continuing single-process. If this is a multi-host run, pass "
+                "--coordinator_address/--num_processes/--process_id explicitly.",
+                RuntimeWarning,
+            )
 
     def _get_world_size(self) -> int:
         return jax.process_count()

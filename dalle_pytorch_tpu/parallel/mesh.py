@@ -134,7 +134,19 @@ class Partitioner:
         def _shard(x):
             x = np.asarray(x)
             global_rows = x.shape[0] * jax.process_count()
-            axes = self.batch_axes if global_rows % batch_size == 0 else None
+            if global_rows % batch_size != 0:
+                if jax.process_count() > 1:
+                    # A replicated fallback would be *wrong* multi-host: each
+                    # process holds different rows of what the runtime would
+                    # treat as one identical replicated array.
+                    raise ValueError(
+                        f"global batch {global_rows} not divisible by mesh batch "
+                        f"axes ({batch_size}); use drop_last=True or pad the "
+                        "final batch"
+                    )
+                axes = None
+            else:
+                axes = self.batch_axes
             sharding = NamedSharding(self.mesh, P(axes, *([None] * (x.ndim - 1))))
             return jax.make_array_from_process_local_data(sharding, x)
 

@@ -32,7 +32,7 @@ class TrainLogger:
         if self.is_root and use_wandb and _wandb is not None and project is not None:
             self.run = _wandb.init(project=project, config=config or {})
             log_filename = log_filename or f"{self.run.name}.txt"
-        if log_filename is not None:
+        if log_filename is not None and self.is_root:
             Path(log_filename).parent.mkdir(parents=True, exist_ok=True)
             self._f = open(log_filename, "a+")
         self.log_filename = log_filename
@@ -42,10 +42,10 @@ class TrainLogger:
         return self.run.name if self.run is not None else "local-run"
 
     def step(self, epoch: int, it: int, loss: float, lr: float, extra: Optional[dict] = None):
-        if self._f is not None:
-            self._f.write(f"{epoch} {it} {loss} {lr}\n")
         if not self.is_root:
             return
+        if self._f is not None:
+            self._f.write(f"{epoch} {it} {loss} {lr}\n")
         if it % self.print_every == 0:
             print(epoch, it, f"loss - {loss}")
             sys.stdout.flush()

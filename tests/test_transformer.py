@@ -46,24 +46,26 @@ def test_reversible_custom_vjp_grad_equivalence():
     """O(1)-memory custom_vjp backward must produce the same gradients as
     plain autodiff through the identical two-stream forward (the analog of
     the reference's reversible-vs-stored-activation equivalence,
-    reversible.py:70-124)."""
+    reversible.py:70-124) — including with a *partial* key-padding mask,
+    which rides through the custom_vjp inside the f-params pytree."""
     tf, params, x = _build(True)
+    tf_naive = Transformer(dim=32, depth=3, seq_len=20, causal=True, heads=2,
+                           dim_head=8, attn_types=("full",), reversible=True,
+                           reversible_naive=True)
+    mask = jnp.arange(20)[None, :] < jnp.asarray([12, 20])[:, None]
 
-    def loss_custom(p):
-        return (tf.apply(p, x) ** 2).sum()
+    for m in (None, mask):
+        def loss_custom(p):
+            return (tf.apply(p, x, m) ** 2).sum()
 
-    # plain-autodiff twin: same params — an all-True key mask is a no-op on
-    # the math but routes the reversible path to the naive executor
-    mask = jnp.ones((2, 20), bool)
+        def loss_naive(p):
+            return (tf_naive.apply(p, x, m) ** 2).sum()
 
-    def loss_naive(p):
-        return (tf.apply(p, x, mask) ** 2).sum()
-
-    l1, g1 = jax.value_and_grad(loss_custom)(params)
-    l2, g2 = jax.value_and_grad(loss_naive)(params)
-    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
-    jax.tree.map(lambda a, b: np.testing.assert_allclose(
-        np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5), g1, g2)
+        l1, g1 = jax.value_and_grad(loss_custom)(params)
+        l2, g2 = jax.value_and_grad(loss_naive)(params)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5), g1, g2)
 
 
 def test_reversible_executor_primitives():
