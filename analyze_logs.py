@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Analyze training step logs (`epoch iter loss lr` lines).
+
+Script equivalent of the reference's `all-logs/analyze-cub-b-logs.ipynb`:
+loads one or more run logs, prints per-epoch mean/std loss (and final lr)
+per run, and optionally writes a CSV summary.
+
+Usage: python analyze_logs.py run1.txt run2.txt [--csv summary.csv]
+"""
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import numpy as np
+
+
+def load_log(path: str | Path) -> np.ndarray:
+    """-> float array [steps, 4] of (epoch, iter, loss, lr)."""
+    rows = []
+    for line in Path(path).read_text().strip().split("\n"):
+        parts = line.split()
+        if len(parts) == 4:
+            rows.append([float(p) for p in parts])
+    return np.asarray(rows)
+
+
+def per_epoch_stats(data: np.ndarray) -> list[dict]:
+    out = []
+    for e in np.unique(data[:, 0]).astype(int):
+        sel = data[data[:, 0] == e]
+        out.append({
+            "epoch": int(e),
+            "iters": int(sel.shape[0]),
+            "loss_mean": float(sel[:, 2].mean()),
+            "loss_std": float(sel[:, 2].std()),
+            "lr": float(sel[-1, 3]),
+        })
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("logs", nargs="+", help="step log files")
+    parser.add_argument("--csv", type=str, default=None)
+    args = parser.parse_args(argv)
+
+    all_rows = []
+    for log in args.logs:
+        data = load_log(log)
+        if data.size == 0:
+            print(f"{log}: empty")
+            continue
+        name = Path(log).stem
+        stats = per_epoch_stats(data)
+        print(f"== {name}: {data.shape[0]} steps, "
+              f"{len(stats)} epochs, start loss {data[0, 2]:.4f}, "
+              f"final epoch-mean loss {stats[-1]['loss_mean']:.4f}")
+        for s in stats:
+            print(f"  epoch {s['epoch']:3d}: loss {s['loss_mean']:.4f} "
+                  f"± {s['loss_std']:.4f} ({s['iters']} iters, lr {s['lr']:.2e})")
+            all_rows.append(dict(run=name, **s))
+
+    if args.csv and all_rows:
+        import csv
+
+        with open(args.csv, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(all_rows[0]))
+            w.writeheader()
+            w.writerows(all_rows)
+        print(f"wrote {args.csv}")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import optax
 
 
 def main():
@@ -40,25 +39,27 @@ def main():
     tx = make_optimizer(3e-4)
     opt_state = jax.jit(tx.init)(params)
 
-    @jax.jit
-    def train_step(params, opt_state, text, codes):
-        def loss_fn(p):
-            return model.apply({"params": p}, text, codes, return_loss=True)
+    # the production train step (buffer donation included) — benches what
+    # train_dalle.py actually runs, on the codes path
+    from dalle_pytorch_tpu.training import make_dalle_train_step
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+    train_step = make_dalle_train_step(model, tx, vae=None)
+
+    def step(params, opt_state, rng):
+        rng, k = jax.random.split(rng)
+        params, opt_state, loss = train_step(params, opt_state, None, text,
+                                             codes, k)
+        return params, opt_state, loss, rng
 
     # warmup (compile + 2 steady steps)
     for _ in range(3):
-        params, opt_state, loss = train_step(params, opt_state, text, codes)
+        params, opt_state, loss, rng = step(params, opt_state, rng)
     loss.block_until_ready()
 
     steps = 100
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, opt_state, loss = train_step(params, opt_state, text, codes)
+        params, opt_state, loss, rng = step(params, opt_state, rng)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
 

@@ -1,0 +1,123 @@
+"""Shared plumbing for the CLI entry scripts (train_vae / train_dalle /
+generate / genrank): tokenizer selection, checkpoint reconstitution, chunked
+generation, and multi-host-safe host fetches.
+
+One implementation instead of the reference's per-script copies
+(tokenizer selection: ref train_dalle.py:105-112 vs generate.py:59-66;
+model loading: ref generate.py:72-87 vs genrank.py:25-44).
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import DALLE, DALLEConfig, DiscreteVAE, VAEConfig
+from .data.tokenizer import ChineseTokenizer, HugTokenizer, SimpleTokenizer
+from .models.dalle import generate_codes
+from .utils.checkpoint import load_checkpoint
+
+
+def select_tokenizer(bpe_path: Optional[str], chinese: bool = False):
+    """Tokenizer priority matching the reference (train_dalle.py:105-112):
+    explicit BPE file > chinese > CLIP SimpleTokenizer.  The CLIP merges txt
+    is data we don't bundle, so the default also needs --bpe_path; json
+    selects the HF tokenizer, anything else the CLIP BPE."""
+    if bpe_path is not None:
+        if str(bpe_path).endswith('.json'):
+            return HugTokenizer(bpe_path)
+        return SimpleTokenizer(bpe_path)
+    if chinese:
+        return ChineseTokenizer()
+    raise SystemExit(
+        '--bpe_path is required: pass the CUB HF-tokenizer json '
+        '(cub200_bpe_vsize_7800.json) or a CLIP merges txt '
+        '(bpe_simple_vocab_16e6.txt)')
+
+
+def load_dalle_checkpoint(dalle_path: str | Path, taming: bool = False):
+    """Rebuild DALLE + VAE from a checkpoint (ref generate.py:72-87), with
+    the same VAE priority: stored custom VAE hparams > pretrained
+    (OpenAI dVAE, or VQGAN when `taming`).
+
+    Returns (dalle, cfg, params, vae, vae_params).
+    """
+    dalle_path = Path(dalle_path)
+    assert dalle_path.exists(), 'trained DALL-E must exist'
+    ckpt = load_checkpoint(dalle_path)
+    dalle_params = dict(ckpt['hparams'])
+    dalle_params.pop('vae', None)  # legacy cleanup (ref generate.py:75)
+    vae_hparams = ckpt.get('vae_params')
+
+    if vae_hparams is not None:
+        vae = DiscreteVAE(VAEConfig.from_dict(dict(vae_hparams)))
+        vae_weights = ckpt.get('vae_weights')
+        vae_params = (jax.tree.map(jnp.asarray, vae_weights)
+                      if vae_weights is not None else None)
+    else:
+        from .models.pretrained_vae import OpenAIDiscreteVAE, VQGanVAE1024
+
+        vae = VQGanVAE1024() if taming else OpenAIDiscreteVAE()
+        vae._require_params()
+        vae_params = None
+
+    cfg = DALLEConfig.from_dict(dalle_params)
+    dalle = DALLE(cfg)
+    params = jax.tree.map(jnp.asarray, ckpt['weights'])
+    return dalle, cfg, params, vae, vae_params
+
+
+def make_decode_fn(vae, vae_params):
+    """Jitted codes -> [b, h, w, 3] float images in [0, 1]."""
+
+    @jax.jit
+    def decode(codes):
+        if isinstance(vae, DiscreteVAE):
+            return vae.apply({'params': vae_params}, codes,
+                             method=DiscreteVAE.decode)
+        return vae.decode(codes)
+
+    return decode
+
+
+def generate_chunked(dalle, params, decode, text_tokens: np.ndarray, *,
+                     batch_size: int, top_k: float, rng,
+                     temperature: float = 1.0, desc: str = 'generating'):
+    """Generate images for [n, text_seq_len] tokens in `batch_size` chunks.
+
+    Pads the last chunk (keeping one compiled shape) and drops the padding
+    rows from the output.  Returns (images [n, h, w, 3], rng).
+    """
+    outs = []
+    n = text_tokens.shape[0]
+    # one short request compiles at its natural size; padding only pays for
+    # itself when it saves a recompile across multiple chunks
+    batch_size = min(batch_size, n) if n else batch_size
+    for s in range(0, n, batch_size):
+        chunk = text_tokens[s: s + batch_size]
+        pad = batch_size - chunk.shape[0]
+        if pad:
+            chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, 0)])
+        rng, key = jax.random.split(rng)
+        codes = generate_codes(dalle, {'params': params},
+                               jnp.asarray(chunk, jnp.int32), key,
+                               filter_thres=top_k, temperature=temperature)
+        images = np.asarray(jax.device_get(decode(codes)))
+        outs.append(images[: batch_size - pad] if pad else images)
+        print(f'{desc}: {min(s + batch_size, n)}/{n}', flush=True)
+    return (np.concatenate(outs) if outs else np.zeros((0,))), rng
+
+
+def host_fetch(tree):
+    """Fetch a (possibly GSPMD-sharded) pytree to host numpy, multi-host
+    safe: with >1 process a plain `device_get` on arrays spanning
+    non-addressable devices raises, so every process participates in an
+    allgather and each gets the full value (root then writes the file)."""
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(tree, tiled=False)

@@ -20,9 +20,15 @@ from flax import serialization
 
 
 def _to_numpy(tree):
-    return jax.tree.map(
-        lambda x: np.asarray(x) if hasattr(x, "shape") else x, tree
-    )
+    """Arrays -> numpy; tuples -> lists (msgpack has no tuple type — configs
+    restore them via their `from_dict`, e.g. VAEConfig.normalization)."""
+    if isinstance(tree, (list, tuple)):
+        return [_to_numpy(v) for v in tree]
+    if isinstance(tree, dict):
+        return {k: _to_numpy(v) for k, v in tree.items()}
+    if hasattr(tree, "shape"):
+        return np.asarray(tree)
+    return tree
 
 
 def save_checkpoint(path: str | Path, obj: dict) -> None:

@@ -1,0 +1,37 @@
+"""Host-side image saving helpers shared by the CLIs.
+
+Replaces torchvision's ``save_image(..., normalize=True)`` / ``make_grid``
+surface used across the reference scripts (train_vae.py:196-207,
+generate.py:114-115, genrank.py:47-51): our decoders already emit [0, 1]
+floats, so a clip + uint8 PNG/JPEG write is the equivalent.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+def to_uint8(img: np.ndarray) -> np.ndarray:
+    return (np.clip(np.asarray(img, np.float32), 0.0, 1.0) * 255).astype(np.uint8)
+
+
+def save_image(path: str | Path, img: np.ndarray) -> None:
+    """Save one [h, w, 3] float image in [0, 1]."""
+    from PIL import Image
+
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    Image.fromarray(to_uint8(img)).save(path)
+
+
+def save_image_grid(path: str | Path, images: np.ndarray, pad: int = 2) -> None:
+    """Save a [n, h, w, 3] float batch as one horizontal strip."""
+    from PIL import Image
+
+    images = np.clip(np.asarray(images, dtype=np.float32), 0.0, 1.0)
+    n, h, w, c = images.shape
+    grid = np.ones((h, n * (w + pad) - pad, c), dtype=np.float32)
+    for i, img in enumerate(images):
+        grid[:, i * (w + pad): i * (w + pad) + w] = img
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    Image.fromarray((grid * 255).astype(np.uint8)).save(path)

@@ -29,9 +29,17 @@ class TrainLogger:
         self.print_every = print_every
         self.run = None
         self._f = None
+        self._local_name = None
         if self.is_root and use_wandb and _wandb is not None and project is not None:
             self.run = _wandb.init(project=project, config=config or {})
             log_filename = log_filename or f"{self.run.name}.txt"
+        elif self.is_root and project is not None and log_filename is None:
+            # no wandb: synthesize a run name so the `{run}.txt` step log (the
+            # reference's all-logs/*.txt artifact, train_dalle.py:351-353)
+            # still exists
+            import time as _time
+            self._local_name = f"{project}-{_time.strftime('%Y%m%d-%H%M%S')}"
+            log_filename = f"{self._local_name}.txt"
         if log_filename is not None and self.is_root:
             Path(log_filename).parent.mkdir(parents=True, exist_ok=True)
             self._f = open(log_filename, "a+")
@@ -39,7 +47,9 @@ class TrainLogger:
 
     @property
     def run_name(self) -> str:
-        return self.run.name if self.run is not None else "local-run"
+        if self.run is not None:
+            return self.run.name
+        return self._local_name or "local-run"
 
     def step(self, epoch: int, it: int, loss: float, lr: float, extra: Optional[dict] = None):
         if not self.is_root:

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Run the 16-caption qualitative suite against one checkpoint (ref
+# generate-16-captioned.sh:1-2 + 16-captions.txt): each caption was chosen
+# to span CUB species/colors (ref 16-captions-explanation.txt).
+#
+# Usage: ./generate-16-captioned.sh dalle.pt [genrank args...]
+set -eu
+CKPT="${1:?usage: generate-16-captioned.sh <ckpt> [genrank args...]}"
+shift 1
+while IFS= read -r caption; do
+    [ -z "$caption" ] && continue
+    echo "=== generating: $caption ==="
+    python genrank.py --dalle_path "$CKPT" --text "$caption" \
+        --num_images 16 "$@"
+done < 16-captions.txt

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Generate images for a caption and re-rank them with CLIP — eval harness.
+
+Capability parity with `/root/reference/genrank.py`: generate ``num_images``
+for one caption from a DALL-E checkpoint (batch 16, top_k 0.9 hard-coded as
+in ref :141-145), save them, re-read the saved JPEGs through the CLIP
+preprocessing (resize 224 + normalize; ref :54-59), score with CLIP
+``logits_per_text`` (ref :68-77), write a sorted 4-wide ranking grid image +
+a ``.npy`` of logits per model (ref :80-112, :128-135), and append
+``"{mname} {mean} {std}"`` to ``results.txt`` (ref :166-167).  The model
+name is parsed from the checkpoint filename (ref :160-161).
+
+TPU-native: the ranker is this framework's own JAX ``CLIP`` model (see
+``dalle_pytorch_tpu/models/clip.py``) loaded from ``--clip_path`` — either a
+CLIP trained with ``train_clip``-style steps or converted ViT-B/32 weights.
+The reference instead downloads OpenAI's torch CLIP, which needs network
+egress.  Without ``--clip_path`` the harness still generates + saves + grids
+the images and records unranked order.
+"""
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu.models.clip import CLIP, CLIPConfig
+from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+# hard-coded harness constants, as the reference (genrank.py:141-145)
+BATCH_SIZE = 16
+TOP_K = 0.9
+DEFAULT_BPE = './cub200_bpe_vsize_7800.json'
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dalle_path', type=str, required=True)
+    parser.add_argument('--text', type=str, required=True)
+    parser.add_argument('--out_path', type=str, default='./genrank_outputs')
+    parser.add_argument('--num_images', type=int, default=16)
+    parser.add_argument('--bpe_path', type=str, default=DEFAULT_BPE)
+    parser.add_argument('--clip_path', type=str, default=None,
+                        help='checkpoint of a JAX CLIP ranker '
+                             '({hparams, weights}); omit to skip ranking')
+    parser.add_argument('--taming', action='store_true')
+    return parser.parse_args(argv)
+
+
+def generate_images(dalle_path, text, num_images, batch_size, top_k, bpe_path,
+                    taming=True):
+    """Generate `num_images` for one caption (ref genrank.py:25-44)."""
+    from dalle_pytorch_tpu.cli import (generate_chunked,
+                                       load_dalle_checkpoint, make_decode_fn,
+                                       select_tokenizer)
+
+    tokenizer = select_tokenizer(bpe_path)
+    dalle, cfg, params, vae, vae_params = load_dalle_checkpoint(
+        dalle_path, taming=taming)
+    decode = make_decode_fn(vae, vae_params)
+
+    tokens = tokenizer.tokenize([text], cfg.text_seq_len, truncate_text=True)
+    tokens = np.repeat(tokens, num_images, axis=0)
+    images, _ = generate_chunked(
+        dalle, params, decode, tokens, batch_size=batch_size, top_k=top_k,
+        rng=jax.random.PRNGKey(0), temperature=1.0,
+        desc=f'generating for ranking')
+    return images, tokenizer
+
+
+def save_outputs(outputs, folder):
+    from dalle_pytorch_tpu.utils.images import save_image
+
+    odir = Path(folder)
+    odir.mkdir(parents=True, exist_ok=True)
+    for i, image in enumerate(outputs):
+        save_image(odir / f'{i}.jpg', image)
+
+
+def read_images(folder, num_images):
+    """Re-read the saved JPEGs — the reference deliberately round-trips
+    through disk before ranking (ref :54-59)."""
+    from PIL import Image
+
+    ims = []
+    for x in range(num_images):
+        img = Image.open(f'{folder}/{x}.jpg').convert('RGB')
+        ims.append(np.asarray(img, np.float32) / 255.0)
+    return np.stack(ims)
+
+
+# CLIP image preprocessing constants (OpenAI CLIP normalize)
+_CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+_CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+def clip_ranking(clip_model, clip_params, tokenizer, images, caption):
+    """Softmax probs + raw logits_per_text over the candidates (ref :68-77)."""
+    size = clip_model.cfg.visual_image_size
+    ims = jax.image.resize(jnp.asarray(images),
+                           (images.shape[0], size, size, 3), 'bilinear')
+    ims = (ims - _CLIP_MEAN) / _CLIP_STD
+    text = tokenizer.tokenize([caption], clip_model.cfg.text_seq_len,
+                              truncate_text=True)
+    text = jnp.asarray(text, jnp.int32)
+
+    @jax.jit
+    def score(params, text, ims):
+        text_lat = clip_model.apply({'params': params}, text,
+                                    method=CLIP.encode_text)
+        img_lat = clip_model.apply({'params': params}, ims,
+                                   method=CLIP.encode_image)
+        temp = jnp.exp(params['temperature'])
+        return (text_lat @ img_lat.T) * temp  # [1, n] logits_per_text
+
+    logits = np.asarray(jax.device_get(score(clip_params, text, ims)))[0]
+    probs = np.exp(logits - logits.max())
+    probs = probs / probs.sum()
+    return probs, logits
+
+
+def show_reranking(images, scores, logits, sort=True, cols_wide=4):
+    """Sorted ranking grid with score captions -> one RGB array per row of 4
+    (ref :80-112, matplotlib replaced with a PIL compositor)."""
+    from PIL import Image, ImageDraw
+
+    if sort:
+        order = np.argsort(scores)[::-1]
+        images, scores, logits = images[order], scores[order], logits[order]
+
+    n, h, w, _ = images.shape
+    label_h = 18
+    figs = []
+    for start in range(0, n, cols_wide):
+        row = images[start: start + cols_wide]
+        # fixed strip width so rows concatenate even when the last is short
+        strip = Image.new('RGB', (cols_wide * w, h + label_h), 'white')
+        draw = ImageDraw.Draw(strip)
+        for k in range(row.shape[0]):
+            img = (np.clip(row[k], 0, 1) * 255).astype(np.uint8)
+            strip.paste(Image.fromarray(img), (k * w, label_h))
+            draw.text((k * w + 2, 2),
+                      f'{np.around(scores[start + k] * 100, 2)}%  '
+                      f'{logits[start + k]:.2f}', fill='black')
+        figs.append(np.asarray(strip))
+    return figs
+
+
+def get_model_output(dalle_path, out_path, text, num_images, bpe_path,
+                     clip_path, taming):
+    ims, tokenizer = generate_images(dalle_path, text, num_images, BATCH_SIZE,
+                                     TOP_K, bpe_path, taming)
+    folder = f'{out_path}/{Path(dalle_path).name[:-3]}'
+    save_outputs(ims, folder)
+    reread = read_images(folder, num_images)
+
+    if clip_path is not None:
+        ckpt = load_checkpoint(clip_path)
+        clip_cfg = CLIPConfig.from_dict(dict(ckpt['hparams']))
+        clip_model = CLIP(clip_cfg)
+        clip_params = jax.tree.map(jnp.asarray, ckpt['weights'])
+        probs, logits = clip_ranking(clip_model, clip_params, tokenizer,
+                                     reread, text)
+    else:
+        print('no --clip_path: skipping CLIP ranking, recording unranked order')
+        probs = np.full((num_images,), 1.0 / num_images, np.float32)
+        logits = np.zeros((num_images,), np.float32)
+    figs = show_reranking(reread, probs, logits)
+    return figs, probs, logits
+
+
+def main(argv=None):
+    from PIL import Image
+
+    args = parse_args(argv)
+    out_path = Path(args.out_path)
+    out_path.mkdir(parents=True, exist_ok=True)
+
+    # model name parsed from the ckpt filename (ref :160-161)
+    mname = Path(args.dalle_path).name.replace('.pt', '')
+
+    figs, probs, logits = get_model_output(
+        args.dalle_path, args.out_path, args.text, args.num_images,
+        args.bpe_path, args.clip_path, args.taming)
+
+    fname = out_path / f'B{mname}'
+    np.save(fname, logits)
+    Image.fromarray(np.concatenate(figs, axis=0)).save(f'{fname}.png')
+
+    with open(out_path / 'results.txt', 'a') as f:
+        f.write(f'{mname} {np.mean(logits)} {np.std(logits)}\n')
+    print(f'{mname}: mean logit {np.mean(logits):.4f} std {np.std(logits):.4f}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Sweep a list of DALL-E checkpoints through the CLIP re-ranking harness,
+# timing each run (the reference's de-facto perf benchmark: /usr/bin/time -p
+# around 512-image genrank runs, ref rank_models.sh:1-2).
+#
+# Usage: ./rank_models.sh models-to-rank.txt "a yellow bird with grey wings" [genrank args...]
+set -eu
+LIST="${1:?usage: rank_models.sh <ckpt-list.txt> <caption> [genrank args...]}"
+CAPTION="${2:?missing caption}"
+shift 2
+while IFS= read -r ckpt; do
+    [ -z "$ckpt" ] && continue
+    echo "=== ranking $ckpt ==="
+    /usr/bin/time -p python genrank.py --dalle_path "$ckpt" \
+        --text "$CAPTION" --num_images 512 "$@"
+done < "$LIST"
