@@ -9,25 +9,35 @@ whose loss curves are the repo's only committed perf artifact
 (all-logs/cool-frog-21.txt, BASELINE.md).  The reference publishes no
 throughput numbers ("published": {} in BASELINE.json), so vs_baseline is
 null.
+
+Measurement: the production train step (training.make_dalle_train_step,
+codes path) is iterated inside a jitted ``lax.scan`` — one dispatch covers
+all steps, so the number reflects device time, not host/RPC dispatch (the
+remote-tunnel runtime's ``block_until_ready`` is unreliable for timing
+loops of small dispatches).  The final loss is fetched with ``device_get``,
+which cannot complete before the whole scan has run.
 """
 from __future__ import annotations
 
+import functools
 import json
 import time
 
 import jax
 import jax.numpy as jnp
 
+STEPS = 50
 
-def main():
+
+def run(use_pallas: bool = False, steps: int = STEPS):
     from dalle_pytorch_tpu import DALLE, DALLEConfig
-    from dalle_pytorch_tpu.training import make_optimizer
+    from dalle_pytorch_tpu.training import make_dalle_train_step, make_optimizer
 
     cfg = DALLEConfig(
         dim=256, num_text_tokens=7800, text_seq_len=80, depth=8, heads=8,
         dim_head=64, attn_types=("full", "axial_row", "axial_col", "conv_like"),
         num_image_tokens=8192, image_size=256, image_fmap_size=32,
-        dtype=jnp.bfloat16,
+        use_pallas=use_pallas, dtype=jnp.bfloat16,
     )
     model = DALLE(cfg)
     batch = 16
@@ -39,31 +49,36 @@ def main():
     tx = make_optimizer(3e-4)
     opt_state = jax.jit(tx.init)(params)
 
-    # the production train step (buffer donation included) — benches what
-    # train_dalle.py actually runs, on the codes path
-    from dalle_pytorch_tpu.training import make_dalle_train_step
+    step_fn = make_dalle_train_step(model, tx, vae=None, jit=False)
 
-    train_step = make_dalle_train_step(model, tx, vae=None)
+    @functools.partial(jax.jit, static_argnames="n_steps")
+    def run_steps(params, opt_state, rng, n_steps):
+        def body(carry, _):
+            params, opt_state, rng = carry
+            rng, k = jax.random.split(rng)
+            params, opt_state, loss = step_fn(params, opt_state, None, text,
+                                              codes, k)
+            return (params, opt_state, rng), loss
 
-    def step(params, opt_state, rng):
-        rng, k = jax.random.split(rng)
-        params, opt_state, loss = train_step(params, opt_state, None, text,
-                                             codes, k)
-        return params, opt_state, loss, rng
+        (params, opt_state, rng), losses = jax.lax.scan(
+            body, (params, opt_state, rng), None, length=n_steps)
+        return params, opt_state, losses[-1]
 
-    # warmup (compile + 2 steady steps)
-    for _ in range(3):
-        params, opt_state, loss, rng = step(params, opt_state, rng)
-    loss.block_until_ready()
+    # warmup: compiles the scan at the measured length
+    p, o, loss = run_steps(params, opt_state, rng, steps)
+    assert jnp.isfinite(jax.device_get(loss)), "non-finite warmup loss"
 
-    steps = 100
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss, rng = step(params, opt_state, rng)
-    loss.block_until_ready()
+    p, o, loss = run_steps(p, o, rng, steps)
+    final = float(jax.device_get(loss))  # forces the whole scan to finish
     dt = time.perf_counter() - t0
+    assert jnp.isfinite(final), "non-finite bench loss"
 
-    images_per_sec = batch * steps / dt
+    return batch * steps / dt, dt
+
+
+def main():
+    images_per_sec, _ = run(use_pallas=False)
     print(json.dumps({
         "metric": "dalle_cub200_train_throughput",
         "value": round(images_per_sec, 2),

@@ -62,6 +62,7 @@ class DALLEConfig:
     image_fmap_size: int = 32
     # TPU-native extras
     use_remat: bool = False
+    use_pallas: bool = False   # Pallas flash/block-sparse attention
     dtype: Any = jnp.float32
 
     @property
@@ -150,7 +151,8 @@ class DALLE(nn.Module):
             attn_dropout=cfg.attn_dropout, ff_dropout=cfg.ff_dropout,
             attn_types=tuple(attn_types), image_fmap_size=cfg.image_fmap_size,
             text_len=cfg.text_seq_len + 1, reversible=cfg.reversible,
-            use_remat=cfg.use_remat, dtype=cfg.dtype, name="transformer")
+            use_remat=cfg.use_remat, use_pallas=cfg.use_pallas,
+            dtype=cfg.dtype, name="transformer")
         self.final_norm = nn.LayerNorm(dtype=jnp.float32, name="final_norm")
         self.to_logits_dense = nn.Dense(cfg.total_tokens, dtype=jnp.float32,
                                         name="to_logits_dense")

@@ -194,24 +194,25 @@ def pattern_mask_row(pattern: AttnPattern, index, n_k: int,
     return _allowed(pattern, index, j, jnp, layout=layout)
 
 
-def _merge_key_pad_mask(pattern: AttnPattern, allow, key_mask):
-    """Apply a per-sample key padding mask [b, n_text_mask] (True = keep).
-
-    Parity: the full variant applies it to every key (attention.py:51-54);
-    sparse variants apply it to the text keys only (:99-102, :208-211).
-    `allow` is [..., n_q, n_k]; returns [b, 1, n_q, n_k]-broadcastable mask.
-    """
-    if key_mask is None:
-        return allow
-    b, m = key_mask.shape
-    n_k = allow.shape[-1]
+def _scope_key_pad(pattern: AttnPattern, key_mask, n_k: int):
+    """Per-variant scope of a [b, m] key padding mask (True = keep) -> [b,
+    n_k] bool.  Parity: the full variant applies it to every key
+    (attention.py:51-54); sparse variants apply it to the text keys only
+    (:99-102, :208-211) — positions beyond its scope are kept."""
     if pattern.variant != "full":
         key_mask = key_mask[:, : pattern.text_len]
-        m = key_mask.shape[1]
+    m = key_mask.shape[1]
     if m >= n_k:
-        pad = key_mask[:, :n_k]
-    else:
-        pad = jnp.pad(key_mask, ((0, 0), (0, n_k - m)), constant_values=True)
+        return key_mask[:, :n_k]
+    return jnp.pad(key_mask, ((0, 0), (0, n_k - m)), constant_values=True)
+
+
+def _merge_key_pad_mask(pattern: AttnPattern, allow, key_mask):
+    """`allow` is [..., n_q, n_k]; returns [b, 1, n_q, n_k]-broadcastable
+    boolean mask with the scoped key padding applied."""
+    if key_mask is None:
+        return allow
+    pad = _scope_key_pad(pattern, key_mask, allow.shape[-1])
     return allow & pad[:, None, None, :]
 
 
@@ -228,6 +229,7 @@ class MultiHeadAttention(nn.Module):
     heads: int = 8
     dim_head: int = 64
     dropout: float = 0.0
+    use_pallas: bool = False
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -243,20 +245,39 @@ class MultiHeadAttention(nn.Module):
         split = lambda t: t.reshape(b, n, self.heads, self.dim_head).transpose(0, 2, 1, 3)
         return split(q), split(k), split(v)
 
+    def _key_pad_bias(self, mask, n):
+        """[b, m] bool key mask -> additive f32 [b, n] bias, same scoping as
+        the dense path (`_scope_key_pad`)."""
+        if mask is None:
+            return None
+        pad = _scope_key_pad(self.pattern, mask, n)
+        return jnp.where(pad, 0.0, -1e30).astype(jnp.float32)
+
     def __call__(self, x, mask=None, deterministic: bool = True,
                  return_kv: bool = False):
         b, n, _ = x.shape
         q, k, v = self._qkv(x)
-        scale = self.dim_head ** -0.5
 
-        dots = jnp.einsum("bhid,bhjd->bhij", q * scale, k,
-                          preferred_element_type=jnp.float32)
-        allow = jnp.asarray(dense_pattern_mask(self.pattern, n, n))[None, None]
-        allow = _merge_key_pad_mask(self.pattern, allow, mask)
-        dots = jnp.where(allow, dots, max_neg_value(dots.dtype))
-        attn = jax.nn.softmax(dots, axis=-1).astype(x.dtype)
+        if self.use_pallas:
+            from .attention_pallas import flash_pattern_attention
 
-        out = jnp.einsum("bhij,bhjd->bhid", attn, v)
+            # the kernels lower through Mosaic only on TPU; anywhere else
+            # (CPU tests, GPU) fall back to the interpreter
+            out = flash_pattern_attention(
+                q, k, v, self.pattern,
+                key_pad_bias=self._key_pad_bias(mask, n),
+                interpret=jax.default_backend() != "tpu")
+        else:
+            scale = self.dim_head ** -0.5
+            dots = jnp.einsum("bhid,bhjd->bhij", q * scale, k,
+                              preferred_element_type=jnp.float32)
+            allow = jnp.asarray(dense_pattern_mask(self.pattern, n, n))[None, None]
+            allow = _merge_key_pad_mask(self.pattern, allow, mask)
+            dots = jnp.where(allow, dots, max_neg_value(dots.dtype))
+            attn = jax.nn.softmax(dots, axis=-1).astype(x.dtype)
+            out = jnp.einsum("bhij,bhjd->bhid", attn, v)
+
+        out = out.astype(x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(b, n, self.heads * self.dim_head)
         out = self.to_out(out)
         out = self.drop(out, deterministic=deterministic)

@@ -46,13 +46,15 @@ class AttnBlock(nn.Module):
     heads: int = 8
     dim_head: int = 64
     dropout: float = 0.0
+    use_pallas: bool = False
     dtype: Any = jnp.float32
 
     def setup(self):
         self.norm = nn.LayerNorm(dtype=jnp.float32, name="norm")
         self.attn = MultiHeadAttention(
             pattern=self.pattern, dim=self.dim, heads=self.heads,
-            dim_head=self.dim_head, dropout=self.dropout, dtype=self.dtype,
+            dim_head=self.dim_head, dropout=self.dropout,
+            use_pallas=self.use_pallas, dtype=self.dtype,
             name="attn",
         )
         self.scale = self.param(
@@ -126,6 +128,7 @@ class Transformer(nn.Module):
     reversible: bool = False
     reversible_naive: bool = False  # test hook: plain-autodiff two-stream
     use_remat: bool = False
+    use_pallas: bool = False   # Pallas flash/block-sparse attention kernels
     sparse_layout_seed: int = 0
     dtype: Any = jnp.float32
 
@@ -148,7 +151,8 @@ class Transformer(nn.Module):
             attn_blocks.append(AttnBlock(
                 pattern=pattern, dim=self.dim, layer_index=ind + 1,
                 heads=self.heads, dim_head=self.dim_head,
-                dropout=self.attn_dropout, dtype=self.dtype,
+                dropout=self.attn_dropout, use_pallas=self.use_pallas,
+                dtype=self.dtype,
                 name=f"layers_{ind}_attn",
             ))
             ff_blocks.append(FFBlock(

@@ -63,11 +63,15 @@ def make_vae_train_step(vae, tx, donate: bool = True):
     return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
 
 
-def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True):
+def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
+                          jit: bool = True):
     """DALLE step.  If `vae` is given, batches carry raw images and the
     (frozen) VAE encodes them to codes inside the step, mirroring the
     reference's in-forward `vae.get_codebook_indices` under no_grad
     (dalle_pytorch.py:459, :144-149); otherwise batches carry codes.
+
+    ``jit=False`` returns the raw function (for embedding in a larger jitted
+    program, e.g. a scan-of-steps benchmark loop).
     """
 
     def train_step(params, opt_state, vae_params, text, images_or_codes, rng):
@@ -88,6 +92,8 @@ def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True):
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
+    if not jit:
+        return train_step
     return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
 
 
