@@ -230,6 +230,7 @@ class MultiHeadAttention(nn.Module):
     dim_head: int = 64
     dropout: float = 0.0
     use_pallas: bool = False
+    ring_axis: Optional[str] = None  # sequence-parallel axis (inside shard_map)
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -258,7 +259,19 @@ class MultiHeadAttention(nn.Module):
         b, n, _ = x.shape
         q, k, v = self._qkv(x)
 
-        if self.use_pallas:
+        if self.ring_axis is not None:
+            # sequence parallelism: x is this device's sequence shard and we
+            # are inside a shard_map over `ring_axis` — exact attention via
+            # the k/v ring rotation (parallel/ring.py)
+            from ..parallel.ring import ring_attention
+
+            assert mask is None, (
+                "ring attention does not take a key padding mask; fold it "
+                "into the token stream instead")
+            out = ring_attention(q, k, v, axis_name=self.ring_axis,
+                                 pattern=self.pattern,
+                                 causal=self.pattern.causal)
+        elif self.use_pallas:
             from .attention_pallas import flash_pattern_attention
 
             # the kernels lower through Mosaic only on TPU; anywhere else

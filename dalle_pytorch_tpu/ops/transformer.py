@@ -47,6 +47,7 @@ class AttnBlock(nn.Module):
     dim_head: int = 64
     dropout: float = 0.0
     use_pallas: bool = False
+    ring_axis: Optional[str] = None
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -54,7 +55,8 @@ class AttnBlock(nn.Module):
         self.attn = MultiHeadAttention(
             pattern=self.pattern, dim=self.dim, heads=self.heads,
             dim_head=self.dim_head, dropout=self.dropout,
-            use_pallas=self.use_pallas, dtype=self.dtype,
+            use_pallas=self.use_pallas, ring_axis=self.ring_axis,
+            dtype=self.dtype,
             name="attn",
         )
         self.scale = self.param(
@@ -129,6 +131,7 @@ class Transformer(nn.Module):
     reversible_naive: bool = False  # test hook: plain-autodiff two-stream
     use_remat: bool = False
     use_pallas: bool = False   # Pallas flash/block-sparse attention kernels
+    ring_axis: Optional[str] = None  # sequence-parallel axis (inside shard_map)
     sparse_layout_seed: int = 0
     dtype: Any = jnp.float32
 
@@ -152,7 +155,7 @@ class Transformer(nn.Module):
                 pattern=pattern, dim=self.dim, layer_index=ind + 1,
                 heads=self.heads, dim_head=self.dim_head,
                 dropout=self.attn_dropout, use_pallas=self.use_pallas,
-                dtype=self.dtype,
+                ring_axis=self.ring_axis, dtype=self.dtype,
                 name=f"layers_{ind}_attn",
             ))
             ff_blocks.append(FFBlock(

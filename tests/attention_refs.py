@@ -1,0 +1,37 @@
+"""Shared dense attention reference for the kernel/parallelism tests.
+
+One implementation of the plain masked-softmax attention that
+`MultiHeadAttention`'s dense path computes, used as ground truth by both the
+Pallas-kernel tests and the ring-attention tests.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.ops.attention import dense_pattern_mask
+
+NEG = -1e30
+
+
+def dense_reference(q, k, v, pattern=None, causal=True, key_pad_bias=None):
+    """f32 masked softmax attention over [b, h, n, dh] q/k/v.
+
+    `pattern` (an AttnPattern) wins over the plain `causal` flag; an
+    optional additive f32 [b, n] `key_pad_bias` carries key padding.
+    """
+    scale = q.shape[-1] ** -0.5
+    dots = jnp.einsum("bhid,bhjd->bhij", q.astype(jnp.float32) * scale,
+                      k.astype(jnp.float32))
+    n = q.shape[2]
+    if pattern is not None:
+        allow = jnp.asarray(dense_pattern_mask(pattern, n, n))[None, None]
+    elif causal:
+        allow = jnp.tril(jnp.ones((n, n), bool))[None, None]
+    else:
+        allow = jnp.ones((n, n), bool)[None, None]
+    if key_pad_bias is not None:
+        dots = dots + key_pad_bias[:, None, None, :]
+    dots = jnp.where(allow, dots, NEG)
+    attn = jax.nn.softmax(dots, axis=-1)
+    return jnp.einsum("bhij,bhjd->bhid", attn, v.astype(jnp.float32))

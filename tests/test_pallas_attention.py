@@ -12,8 +12,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from dalle_pytorch_tpu.ops.attention import AttnPattern, dense_pattern_mask
+from dalle_pytorch_tpu.ops.attention import AttnPattern
 from dalle_pytorch_tpu.ops.attention_pallas import flash_pattern_attention
+
+from attention_refs import dense_reference
 
 TEXT, FMAP = 5, 4
 N = TEXT + FMAP * FMAP  # 21
@@ -24,20 +26,6 @@ BLOCK = 8
 def make_pattern(variant, **kw):
     return AttnPattern(variant=variant, seq_len=N - 1, text_len=TEXT,
                        fmap=FMAP, **kw)
-
-
-def dense_reference(q, k, v, pattern, key_pad_bias=None):
-    """The dense masked attention MultiHeadAttention computes."""
-    scale = q.shape[-1] ** -0.5
-    dots = jnp.einsum("bhid,bhjd->bhij", q.astype(jnp.float32) * scale,
-                      k.astype(jnp.float32))
-    n = q.shape[2]
-    allow = jnp.asarray(dense_pattern_mask(pattern, n, n))[None, None]
-    if key_pad_bias is not None:
-        dots = dots + key_pad_bias[:, None, None, :]
-    dots = jnp.where(allow, dots, -1e30)
-    attn = jax.nn.softmax(dots, axis=-1)
-    return jnp.einsum("bhij,bhjd->bhid", attn, v.astype(jnp.float32))
 
 
 def rand_qkv(key, dtype=jnp.float32):
