@@ -48,7 +48,7 @@ def test_ring_matches_dense(mesh8, causal):
 
 
 @pytest.mark.parametrize("variant", ["full", "axial_row", "axial_col",
-                                     "conv_like"])
+                                     "conv_like", "sparse"])
 def test_ring_with_patterns(mesh8, variant):
     pattern = AttnPattern(variant=variant, seq_len=N - 1, text_len=TEXT,
                           fmap=FMAP)
