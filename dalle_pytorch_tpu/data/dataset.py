@@ -35,29 +35,40 @@ def _load_image(path: Path):
     return img
 
 
-def _to_float_array(img) -> np.ndarray:
-    arr = np.asarray(img, dtype=np.float32) / 255.0
-    return arr
+def _crop_resize_f32(img, top: float, left: float, ch: float, cw: float,
+                     size: int) -> np.ndarray:
+    """Crop box -> bilinear `size`x`size` -> [0,1] f32.  Uses the fused
+    native kernel (data/native.py) when the library is available, else the
+    PIL three-pass path."""
+    from . import native
 
-
-def center_crop_resize(img, size: int):
+    if native.available():
+        out = native.crop_resize_normalize(
+            np.asarray(img, np.uint8), top, left, ch, cw, size)
+        if out is not None:
+            return out
     from PIL import Image
 
+    # one rounding for each origin so width/height stay exactly round(cw/ch)
+    l, t = round(left), round(top)
+    cropped = img.crop((l, t, l + round(cw), t + round(ch)))
+    return np.asarray(cropped.resize((size, size), Image.BILINEAR),
+                      np.float32) / 255.0
+
+
+def center_crop_resize(img, size: int) -> np.ndarray:
+    """Resize-shortest-side + center crop (ref train_vae.py:71-79) as one
+    source-space center-square crop -> [size, size, 3] f32."""
     w, h = img.size
-    scale = size / min(w, h)
-    img = img.resize((max(size, round(w * scale)), max(size, round(h * scale))),
-                     Image.BILINEAR)
-    w, h = img.size
-    left, top = (w - size) // 2, (h - size) // 2
-    return img.crop((left, top, left + size, top + size))
+    side = min(w, h)
+    left, top = (w - side) / 2.0, (h - side) / 2.0
+    return _crop_resize_f32(img, top, left, side, side, size)
 
 
 def random_resized_crop(img, size: int, rng: np.random.Generator,
-                        scale=(0.6, 1.0), ratio=(1.0, 1.0)):
+                        scale=(0.6, 1.0), ratio=(1.0, 1.0)) -> np.ndarray:
     """RandomResizedCrop with the reference's settings: area scale in
     ``(resize_ratio, 1)``, aspect ratio fixed to 1 (train_dalle.py:227)."""
-    from PIL import Image
-
     w, h = img.size
     area = w * h
     for _ in range(10):
@@ -68,8 +79,7 @@ def random_resized_crop(img, size: int, rng: np.random.Generator,
         if 0 < cw <= w and 0 < ch <= h:
             left = int(rng.integers(0, w - cw + 1))
             top = int(rng.integers(0, h - ch + 1))
-            img = img.crop((left, top, left + cw, top + ch))
-            return img.resize((size, size), Image.BILINEAR)
+            return _crop_resize_f32(img, top, left, ch, cw, size)
     return center_crop_resize(img, size)  # fallback, as torchvision does
 
 
@@ -89,8 +99,7 @@ class ImageFolderDataset:
 
     def __getitem__(self, idx: int) -> np.ndarray:
         img = _load_image(self.paths[idx])
-        img = center_crop_resize(img, self.image_size)
-        return _to_float_array(img)
+        return center_crop_resize(img, self.image_size)
 
 
 class TextImageDataset:
@@ -145,9 +154,9 @@ class TextImageDataset:
                     description, self.text_len, truncate_text=self.truncate_captions
                 )[0]
                 img = _load_image(self.image_files[key])
-                img = random_resized_crop(img, self.image_size, rng,
+                arr = random_resized_crop(img, self.image_size, rng,
                                           scale=(self.resize_ratio, 1.0))
-                return tokens, _to_float_array(img)
+                return tokens, arr
             except (OSError, ValueError) as e:
                 print(f"warning: skipping sample {key}: {e}", flush=True)
         raise RuntimeError(
@@ -212,10 +221,20 @@ class DataLoader:
         yield from self._prefetch_iter(batches)
 
     def _collate(self, items):
+        from . import native
+
+        def stack(col):
+            if (col and isinstance(col[0], np.ndarray)
+                    and col[0].dtype == np.float32):
+                out = native.batch_collate(list(col))
+                if out is not None:
+                    return out
+            return np.stack(col)
+
         if isinstance(items[0], tuple):
             cols = list(zip(*items))
-            return tuple(np.stack(c) for c in cols)
-        return np.stack(items)
+            return tuple(stack(c) for c in cols)
+        return stack(items)
 
     def _prefetch_iter(self, batches):
         """Ordered prefetch with real backpressure: at most `prefetch`

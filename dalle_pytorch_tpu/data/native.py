@@ -1,0 +1,127 @@
+"""ctypes bindings to the native host-ops library (native/host_ops.cpp).
+
+Loads ``libdalle_host.so`` (building it with ``make -C native`` on first use
+if a toolchain is available) and exposes the fused
+crop+bilinear-resize+normalize and the threaded batch collate.  Every entry
+point degrades gracefully: callers check ``available()`` and fall back to
+the PIL/numpy path when the library can't be built or loaded.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libdalle_host.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DALLE_TPU_NO_NATIVE"):
+            return None
+        def build() -> bool:
+            try:
+                subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                               capture_output=True, timeout=120)
+                return True
+            except (OSError, subprocess.SubprocessError):
+                return False
+
+        if not _LIB_PATH.exists() and not build():
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError:
+            return None
+        try:
+            stale = lib.dalle_host_ops_version() != 2
+        except AttributeError:
+            stale = True
+        if stale:
+            # a stale .so predating the current source: make rebuilds it
+            # (the .cpp is newer), then reload
+            if not build():
+                return None
+            try:
+                lib = ctypes.CDLL(str(_LIB_PATH))
+                if lib.dalle_host_ops_version() != 2:
+                    return None
+            except (OSError, AttributeError):
+                return None
+
+        lib.crop_resize_normalize_u8_mt.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.batch_collate_f32.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)), ctypes.c_int,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def crop_resize_normalize(img_u8: np.ndarray, top: float, left: float,
+                          ch: float, cw: float, out_size: int,
+                          nthreads: int = 0) -> Optional[np.ndarray]:
+    """Fused crop box -> bilinear resize -> [0,1] f32, or None if the native
+    library is unavailable.  `img_u8` is [h, w, 3] uint8 (C-contiguous)."""
+    lib = _load()
+    if lib is None:
+        return None
+    img_u8 = np.ascontiguousarray(img_u8, dtype=np.uint8)
+    h, w, c = img_u8.shape
+    assert c == 3, "RGB input expected"
+    out = np.empty((out_size, out_size, 3), np.float32)
+    if nthreads <= 0:
+        nthreads = min(4, os.cpu_count() or 1)
+    lib.crop_resize_normalize_u8_mt(
+        img_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        h, w, w * 3,
+        ctypes.c_float(top), ctypes.c_float(left), ctypes.c_float(ch),
+        ctypes.c_float(cw),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_size, out_size, nthreads)
+    return out
+
+
+def batch_collate(samples: list, nthreads: int = 0) -> Optional[np.ndarray]:
+    """Stack same-shape f32 arrays into one batch via the threaded native
+    memcpy, or None if unavailable (caller falls back to np.stack)."""
+    lib = _load()
+    if lib is None or not samples:
+        return None
+    arrs = [np.ascontiguousarray(s, dtype=np.float32) for s in samples]
+    shape = arrs[0].shape
+    if any(a.shape != shape for a in arrs):
+        return None
+    elems = int(np.prod(shape))
+    out = np.empty((len(arrs),) + shape, np.float32)
+    ptrs = (ctypes.POINTER(ctypes.c_float) * len(arrs))(
+        *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for a in arrs])
+    if nthreads <= 0:
+        nthreads = min(8, os.cpu_count() or 1)
+    lib.batch_collate_f32(
+        ptrs, len(arrs), elems,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), nthreads)
+    return out
