@@ -74,11 +74,23 @@ def run(use_pallas: bool = False, steps: int = STEPS):
     dt = time.perf_counter() - t0
     assert jnp.isfinite(final), "non-finite bench loss"
 
-    return batch * steps / dt, dt
+    return batch * steps / dt, dt, cfg, batch
 
 
 def main():
-    images_per_sec, _ = run(use_pallas=False)
+    images_per_sec, dt, cfg, batch = run(use_pallas=False)
+    # MFU context on stderr; the driver consumes only the stdout JSON line.
+    # FLOPs are dense-equivalent (sparse layers counted as full attention),
+    # the convention MFU is normally quoted in for sparse models.
+    import sys
+
+    from dalle_pytorch_tpu.utils.profiling import (dalle_train_flops,
+                                                   device_peak_flops)
+
+    flops = dalle_train_flops(cfg, batch) * STEPS / dt
+    print(f"achieved {flops/1e12:.2f} TFLOP/s (dense-equivalent), "
+          f"MFU {flops/device_peak_flops():.2%}", file=sys.stderr)
+
     print(json.dumps({
         "metric": "dalle_cub200_train_throughput",
         "value": round(images_per_sec, 2),

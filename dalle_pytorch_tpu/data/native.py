@@ -41,26 +41,23 @@ def _load() -> Optional[ctypes.CDLL]:
             except (OSError, subprocess.SubprocessError):
                 return False
 
-        if not _LIB_PATH.exists() and not build():
-            return None
-        try:
-            lib = ctypes.CDLL(str(_LIB_PATH))
-        except OSError:
-            return None
-        try:
-            stale = lib.dalle_host_ops_version() != 2
-        except AttributeError:
-            stale = True
-        if stale:
-            # a stale .so predating the current source: make rebuilds it
-            # (the .cpp is newer), then reload
-            if not build():
-                return None
+        def probe():
             try:
                 lib = ctypes.CDLL(str(_LIB_PATH))
-                if lib.dalle_host_ops_version() != 2:
-                    return None
+                return lib if lib.dalle_host_ops_version() == 2 else None
             except (OSError, AttributeError):
+                return None
+
+        lib = probe() if _LIB_PATH.exists() else None
+        if lib is None:
+            # missing or stale .so: delete first — make would consider a
+            # newer-mtime stale binary up to date, and dlopen caches the old
+            # inode, so an in-place rebuild could never be picked up
+            _LIB_PATH.unlink(missing_ok=True)
+            if not build():
+                return None
+            lib = probe()
+            if lib is None:
                 return None
 
         lib.crop_resize_normalize_u8_mt.argtypes = [

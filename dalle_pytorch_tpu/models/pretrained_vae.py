@@ -115,11 +115,14 @@ class OpenAIDecoder(nn.Module):
 
     @nn.compact
     def __call__(self, codes_onehot_or_emb):
-        h = nn.Conv(self.hidden // 2 * 8, (1, 1), dtype=self.dtype, name="stem")(
+        # published dVAE decoder: 1x1 stem to n_init = hidden//2 (128), then
+        # groups of width hidden*mult (2048/1024/512/256) — the first block
+        # expands n_init -> 8*hidden via its id_path
+        h = nn.Conv(self.hidden // 2, (1, 1), dtype=self.dtype, name="stem")(
             codes_onehot_or_emb)
         for g, mult in enumerate((8, 4, 2, 1)):
             for b in range(self.blocks_per_group):
-                h = _DecBlock(self.hidden // 2 * mult, dtype=self.dtype,
+                h = _DecBlock(self.hidden * mult, dtype=self.dtype,
                               name=f"group_{g}_block_{b}")(h)
             if g < 3:
                 b_, hh, ww, cc = h.shape

@@ -1,0 +1,107 @@
+"""Profiling & efficiency counters: step timing, FLOPs, MFU, trace capture.
+
+The reference's only perf instrumentation is `/usr/bin/time -p` around
+genrank runs (SURVEY.md §5.1).  TPU-natively we report step time,
+images/sec, and MFU (model FLOPs utilization = achieved FLOP/s over the
+chip's peak) — the metric the BASELINE.md target (≥35% MFU) is defined in —
+plus a `jax.profiler` trace context for deeper dives in XProf.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+# peak dense bf16 FLOP/s per chip by device kind substring (public numbers)
+PEAK_FLOPS = (
+    ("v5 lite", 197e12),   # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6e", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def device_peak_flops(default: float = 197e12) -> float:
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # pragma: no cover - no devices
+        return default
+    for sub, peak in PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return default
+
+
+def transformer_train_flops(dim: int, depth: int, seq_len: int, heads: int,
+                            dim_head: int, ff_mult: int, vocab: int,
+                            batch: int) -> float:
+    """Analytic FLOPs for one *training* step (fwd + bwd ≈ 3x fwd) of a
+    GEGLU decoder stack + logits head, matmul terms only."""
+    inner = heads * dim_head
+    per_layer = (
+        2 * seq_len * dim * (3 * inner)        # qkv projection
+        + 2 * seq_len * seq_len * inner * 2    # scores + attn·v
+        + 2 * seq_len * inner * dim            # output projection
+        + 2 * seq_len * dim * (ff_mult * dim * 2)  # GEGLU in
+        + 2 * seq_len * (ff_mult * dim) * dim      # ff out
+    )
+    logits = 2 * seq_len * dim * vocab
+    fwd = depth * per_layer + logits
+    return 3.0 * fwd * batch
+
+
+def dalle_train_flops(cfg, batch: int) -> float:
+    """FLOPs per train step for a DALLEConfig."""
+    return transformer_train_flops(
+        dim=cfg.dim, depth=cfg.depth, seq_len=cfg.seq_len + 1,
+        heads=cfg.heads, dim_head=cfg.dim_head, ff_mult=4,
+        vocab=cfg.total_tokens, batch=batch)
+
+
+class StepTimer:
+    """Wall-clock step timer with EMA, images/sec and MFU reporting.
+
+    Call ``tick(batch)`` once per completed (synced) step.  MFU uses the
+    analytic `flops_per_sample` when provided.
+    """
+
+    def __init__(self, flops_per_step: Optional[float] = None,
+                 ema: float = 0.9):
+        self.flops_per_step = flops_per_step
+        self.ema = ema
+        self.avg_dt: Optional[float] = None
+        self._last: Optional[float] = None
+        # flops_per_step covers the global batch, so peak spans all chips
+        self.peak = device_peak_flops() * max(1, jax.device_count())
+
+    def tick(self, batch: int = 1) -> dict:
+        now = time.perf_counter()
+        out: dict = {}
+        if self._last is not None:
+            dt = now - self._last
+            self.avg_dt = (dt if self.avg_dt is None
+                           else self.ema * self.avg_dt + (1 - self.ema) * dt)
+            out["step_time_s"] = self.avg_dt
+            out["images_per_sec"] = batch / self.avg_dt
+            if self.flops_per_step:
+                out["mfu"] = self.flops_per_step / self.avg_dt / self.peak
+        self._last = now
+        return out
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str = "/tmp/jax-trace", enabled: bool = True):
+    """`jax.profiler` trace context (view with XProf/TensorBoard)."""
+    if not enabled:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,58 @@
+"""Profiling utilities: FLOP estimates, MFU math, step timer."""
+from __future__ import annotations
+
+import time
+
+from dalle_pytorch_tpu import DALLEConfig
+from dalle_pytorch_tpu.utils.profiling import (StepTimer, dalle_train_flops,
+                                               device_peak_flops,
+                                               transformer_train_flops)
+
+
+def test_flops_scale_with_config():
+    cfg1 = DALLEConfig(dim=256, num_text_tokens=7800, text_seq_len=80,
+                       depth=8, num_image_tokens=8192, image_size=256,
+                       image_fmap_size=32)
+    cfg2 = DALLEConfig(dim=256, num_text_tokens=7800, text_seq_len=80,
+                       depth=16, num_image_tokens=8192, image_size=256,
+                       image_fmap_size=32)
+    f1, f2 = dalle_train_flops(cfg1, 16), dalle_train_flops(cfg2, 16)
+    assert f2 > f1 > 0
+    # depth doubling should roughly double the per-layer term
+    assert 1.5 < f2 / f1 < 2.1
+    # batch linearity
+    assert abs(dalle_train_flops(cfg1, 32) / f1 - 2.0) < 1e-6
+
+
+def test_flops_magnitude_sane():
+    """CUB config ~2 TFLOP per step at batch 16 (hand-derived in review)."""
+    cfg = DALLEConfig(dim=256, num_text_tokens=7800, text_seq_len=80,
+                      depth=8, num_image_tokens=8192, image_size=256,
+                      image_fmap_size=32)
+    f = dalle_train_flops(cfg, 16)
+    assert 0.5e12 < f < 5e12, f
+
+
+def test_peak_flops_positive():
+    assert device_peak_flops() > 0
+
+
+def test_step_timer():
+    t = StepTimer(flops_per_step=1e12)
+    assert t.tick(8) == {}  # first tick only arms the timer
+    time.sleep(0.01)
+    out = t.tick(8)
+    assert out["step_time_s"] > 0
+    assert out["images_per_sec"] > 0
+    assert 0 < out["mfu"] < 1e6
+
+
+def test_transformer_flops_terms():
+    # attention term must dominate at long seq, ff at large dim
+    long_seq = transformer_train_flops(dim=64, depth=1, seq_len=4096,
+                                       heads=4, dim_head=16, ff_mult=4,
+                                       vocab=100, batch=1)
+    short_seq = transformer_train_flops(dim=64, depth=1, seq_len=256,
+                                        heads=4, dim_head=16, ff_mult=4,
+                                        vocab=100, batch=1)
+    assert long_seq > short_seq * 16  # quadratic attention term visible

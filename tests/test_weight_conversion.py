@@ -1,0 +1,340 @@
+"""Weight conversion fidelity: torch twins -> converter -> flax graphs.
+
+The real pretrained checkpoints (taming VQGAN, OpenAI dVAE) cannot be
+downloaded in this environment, so these tests build small torch modules
+with the *published* state_dict naming and semantics, convert their weights
+with tools/convert_weights.py, and compare forward passes numerically
+against our flax graphs (SURVEY.md §7 'weight conversion fidelity').
+"""
+from __future__ import annotations
+
+import sys
+from collections import OrderedDict
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from tools.convert_weights import (convert_openai_state_dicts,  # noqa: E402
+                                   convert_vqgan_state_dict)
+
+CH, CH_MULT, NRES, Z = 32, (1, 2), 1, 32
+
+
+# ---------------------------------------------------------------------------
+# torch twin of taming's VQGAN encoder/decoder (taming state_dict naming)
+# ---------------------------------------------------------------------------
+
+
+def swish(x):
+    return x * torch.sigmoid(x)
+
+
+class TResBlock(tnn.Module):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm1 = tnn.GroupNorm(32, cin)
+        self.conv1 = tnn.Conv2d(cin, cout, 3, padding=1)
+        self.norm2 = tnn.GroupNorm(32, cout)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, padding=1)
+        if cin != cout:
+            self.nin_shortcut = tnn.Conv2d(cin, cout, 1)
+        self.has_sc = cin != cout
+
+    def forward(self, x):
+        h = self.conv1(swish(self.norm1(x)))
+        h = self.conv2(swish(self.norm2(h)))
+        if self.has_sc:
+            x = self.nin_shortcut(x)
+        return x + h
+
+
+class TAttnBlock(tnn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.norm = tnn.GroupNorm(32, c)
+        self.q = tnn.Conv2d(c, c, 1)
+        self.k = tnn.Conv2d(c, c, 1)
+        self.v = tnn.Conv2d(c, c, 1)
+        self.proj_out = tnn.Conv2d(c, c, 1)
+
+    def forward(self, x):
+        b, c, h, w = x.shape
+        hn = self.norm(x)
+        q = self.q(hn).reshape(b, c, h * w).permute(0, 2, 1)
+        k = self.k(hn).reshape(b, c, h * w).permute(0, 2, 1)
+        v = self.v(hn).reshape(b, c, h * w).permute(0, 2, 1)
+        attn = torch.softmax(torch.einsum("bic,bjc->bij", q, k) * c ** -0.5, -1)
+        o = torch.einsum("bij,bjc->bic", attn, v)
+        o = o.permute(0, 2, 1).reshape(b, c, h, w)
+        return x + self.proj_out(o)
+
+
+class _Holder(tnn.Module):
+    pass
+
+
+class TVQEncoder(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv_in = tnn.Conv2d(3, CH, 3, padding=1)
+        self.down = tnn.ModuleList()
+        cin = CH
+        for i, mult in enumerate(CH_MULT):
+            lvl = _Holder()
+            lvl.block = tnn.ModuleList()
+            for _ in range(NRES):
+                lvl.block.append(TResBlock(cin, CH * mult))
+                cin = CH * mult
+            if i < len(CH_MULT) - 1:
+                ds = _Holder()
+                ds.conv = tnn.Conv2d(cin, cin, 3, stride=2, padding=0)
+                lvl.downsample = ds
+            self.down.append(lvl)
+        self.mid = _Holder()
+        self.mid.block_1 = TResBlock(cin, cin)
+        self.mid.attn_1 = TAttnBlock(cin)
+        self.mid.block_2 = TResBlock(cin, cin)
+        self.add_module("mid", self.mid)
+        self.norm_out = tnn.GroupNorm(32, cin)
+        self.conv_out = tnn.Conv2d(cin, Z, 3, padding=1)
+
+    def forward(self, x):
+        h = self.conv_in(x)
+        for i in range(len(CH_MULT)):
+            for blk in self.down[i].block:
+                h = blk(h)
+            if i < len(CH_MULT) - 1:
+                h = F.pad(h, (0, 1, 0, 1))  # taming's asymmetric pad
+                h = self.down[i].downsample.conv(h)
+        h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
+        return self.conv_out(swish(self.norm_out(h)))
+
+
+class TVQDecoder(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        cin = CH * CH_MULT[-1]
+        self.conv_in = tnn.Conv2d(Z, cin, 3, padding=1)
+        self.mid = _Holder()
+        self.mid.block_1 = TResBlock(cin, cin)
+        self.mid.attn_1 = TAttnBlock(cin)
+        self.mid.block_2 = TResBlock(cin, cin)
+        self.add_module("mid", self.mid)
+        # taming indexes up[] by resolution level (ascending mult order)
+        self.up = tnn.ModuleList()
+        levels = []
+        for lvl_idx, mult in enumerate(CH_MULT):  # ascending
+            levels.append((lvl_idx, mult))
+        # build in descending forward order but store at ascending index
+        holders = {}
+        for lvl_idx, mult in reversed(levels):
+            lvl = _Holder()
+            lvl.block = tnn.ModuleList()
+            for _ in range(NRES + 1):
+                lvl.block.append(TResBlock(cin, CH * mult))
+                cin = CH * mult
+            if lvl_idx > 0:
+                us = _Holder()
+                us.conv = tnn.Conv2d(cin, cin, 3, padding=1)
+                lvl.upsample = us
+            holders[lvl_idx] = lvl
+        for lvl_idx in sorted(holders):
+            self.up.append(holders[lvl_idx])
+        self.norm_out = tnn.GroupNorm(32, cin)
+        self.conv_out = tnn.Conv2d(cin, 3, 3, padding=1)
+
+    def forward(self, z):
+        h = self.conv_in(z)
+        h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
+        for lvl_idx in reversed(range(len(CH_MULT))):
+            for blk in self.up[lvl_idx].block:
+                h = blk(h)
+            if lvl_idx > 0:
+                h = F.interpolate(h, scale_factor=2.0, mode="nearest")
+                h = self.up[lvl_idx].upsample.conv(h)
+        return self.conv_out(swish(self.norm_out(h)))
+
+
+def _nchw(x_nhwc):
+    return torch.from_numpy(np.transpose(x_nhwc, (0, 3, 1, 2))).float()
+
+
+def _nhwc(t):
+    return np.transpose(t.detach().numpy(), (0, 2, 3, 1))
+
+
+def test_vqgan_encoder_decoder_conversion():
+    from dalle_pytorch_tpu.models.pretrained_vae import (VQGanDecoder,
+                                                         VQGanEncoder)
+
+    torch.manual_seed(0)
+    t_enc, t_dec = TVQEncoder(), TVQDecoder()
+    sd = {f"encoder.{k}": v.numpy() for k, v in t_enc.state_dict().items()}
+    sd.update({f"decoder.{k}": v.numpy() for k, v in t_dec.state_dict().items()})
+    # quantize + 1x1 quant convs
+    rng = np.random.default_rng(0)
+    sd["quantize.embedding.weight"] = rng.normal(size=(16, Z)).astype(np.float32)
+    sd["quant_conv.weight"] = rng.normal(size=(Z, Z, 1, 1)).astype(np.float32) * 0.2
+    sd["quant_conv.bias"] = np.zeros(Z, np.float32)
+    sd["post_quant_conv.weight"] = rng.normal(size=(Z, Z, 1, 1)).astype(np.float32) * 0.2
+    sd["post_quant_conv.bias"] = np.zeros(Z, np.float32)
+
+    params = convert_vqgan_state_dict(sd, ch=CH, ch_mult=CH_MULT,
+                                      num_res_blocks=NRES)
+
+    x = rng.uniform(-1, 1, size=(2, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref_z = _nhwc(t_enc(_nchw(x)))
+    enc = VQGanEncoder(ch=CH, ch_mult=CH_MULT, num_res_blocks=NRES,
+                       z_channels=Z)
+    out_z = np.asarray(enc.apply({"params": params["encoder"]}, jnp.asarray(x)))
+    np.testing.assert_allclose(out_z, ref_z, rtol=1e-4, atol=1e-4)
+
+    z = rng.uniform(-1, 1, size=(2, 8, 8, Z)).astype(np.float32)
+    with torch.no_grad():
+        ref_img = _nhwc(t_dec(_nchw(z)))
+    dec = VQGanDecoder(ch=CH, ch_mult=CH_MULT, num_res_blocks=NRES)
+    out_img = np.asarray(dec.apply({"params": params["decoder"]}, jnp.asarray(z)))
+    np.testing.assert_allclose(out_img, ref_img, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# torch twin of the DALL-E package dVAE (its state_dict naming: custom
+# Conv2d storing `w`/`b`)
+# ---------------------------------------------------------------------------
+
+
+class OaiConv(tnn.Module):
+    def __init__(self, cin, cout, kw):
+        super().__init__()
+        self.w = tnn.Parameter(torch.randn(cout, cin, kw, kw) * 0.1)
+        self.b = tnn.Parameter(torch.zeros(cout))
+        self.kw = kw
+
+    def forward(self, x):
+        return F.conv2d(x, self.w, self.b, padding=(self.kw - 1) // 2)
+
+
+class OaiEncBlock(tnn.Module):
+    def __init__(self, cin, cout):
+        super().__init__()
+        hid = cout // 4
+        self.id_path = OaiConv(cin, cout, 1) if cin != cout else tnn.Identity()
+        self.res_path = tnn.Sequential(OrderedDict([
+            ("relu_1", tnn.ReLU()), ("conv_1", OaiConv(cin, hid, 3)),
+            ("relu_2", tnn.ReLU()), ("conv_2", OaiConv(hid, hid, 3)),
+            ("relu_3", tnn.ReLU()), ("conv_3", OaiConv(hid, hid, 3)),
+            ("relu_4", tnn.ReLU()), ("conv_4", OaiConv(hid, cout, 1)),
+        ]))
+
+    def forward(self, x):
+        return self.id_path(x) + self.res_path(x)
+
+
+class OaiDecBlock(tnn.Module):
+    """Published dVAE decoder block: 1x1 then three 3x3 convs."""
+
+    def __init__(self, cin, cout):
+        super().__init__()
+        hid = cout // 4
+        self.id_path = OaiConv(cin, cout, 1) if cin != cout else tnn.Identity()
+        self.res_path = tnn.Sequential(OrderedDict([
+            ("relu_1", tnn.ReLU()), ("conv_1", OaiConv(cin, hid, 1)),
+            ("relu_2", tnn.ReLU()), ("conv_2", OaiConv(hid, hid, 3)),
+            ("relu_3", tnn.ReLU()), ("conv_3", OaiConv(hid, hid, 3)),
+            ("relu_4", tnn.ReLU()), ("conv_4", OaiConv(hid, cout, 3)),
+        ]))
+
+    def forward(self, x):
+        return self.id_path(x) + self.res_path(x)
+
+
+def test_openai_encoder_conversion():
+    from dalle_pytorch_tpu.models.pretrained_vae import OpenAIEncoder
+
+    HID, BPG = 32, 1
+    torch.manual_seed(1)
+
+    groups = OrderedDict()
+    groups["input"] = OaiConv(3, HID, 7)
+    cin = HID
+    for g, mult in enumerate((1, 2, 4, 8)):
+        grp = OrderedDict()
+        for b in range(BPG):
+            grp[f"block_{b + 1}"] = OaiEncBlock(cin, HID * mult)
+            cin = HID * mult
+        if g < 3:
+            grp["pool"] = tnn.MaxPool2d(2)
+        groups[f"group_{g + 1}"] = tnn.Sequential(grp)
+    groups["output"] = tnn.Sequential(OrderedDict([
+        ("relu", tnn.ReLU()), ("conv", OaiConv(cin, 64, 1))]))
+    model = tnn.Sequential(OrderedDict([("blocks", tnn.Sequential(groups))]))
+
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    params = convert_openai_state_dicts(sd, None, hidden=HID,
+                                        blocks_per_group=BPG)
+
+    rng = np.random.default_rng(2)
+    x = rng.uniform(0, 1, size=(1, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = _nhwc(model(_nchw(x)))
+    enc = OpenAIEncoder(num_tokens=64, hidden=HID, blocks_per_group=BPG)
+    out = np.asarray(enc.apply({"params": params["encoder"]}, jnp.asarray(x)))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_openai_decoder_conversion():
+    from dalle_pytorch_tpu.models.pretrained_vae import OpenAIDecoder
+
+    HID, BPG, VOCAB = 32, 1, 64
+    n_init = HID // 2
+    torch.manual_seed(3)
+
+    groups = OrderedDict()
+    groups["input"] = OaiConv(VOCAB, n_init, 1)
+    cin = n_init
+    ups = []
+    for g, mult in enumerate((8, 4, 2, 1)):
+        grp = OrderedDict()
+        for b in range(BPG):
+            grp[f"block_{b + 1}"] = OaiDecBlock(cin, HID * mult)
+            cin = HID * mult
+        groups[f"group_{g + 1}"] = tnn.Sequential(grp)
+        ups.append(g < 3)
+    groups["output"] = tnn.Sequential(OrderedDict([
+        ("relu", tnn.ReLU()), ("conv", OaiConv(cin, 6, 1))]))
+
+    class TDec(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.blocks = tnn.Sequential(groups)
+
+        def forward(self, x):
+            h = self.blocks.input(x)
+            for g in range(4):
+                h = getattr(self.blocks, f"group_{g + 1}")(h)
+                if ups[g]:
+                    h = F.interpolate(h, scale_factor=2.0, mode="nearest")
+            return self.blocks.output(h)
+
+    model = TDec()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    params = convert_openai_state_dicts(sd, sd, hidden=HID,
+                                        blocks_per_group=BPG)
+
+    rng = np.random.default_rng(4)
+    onehot = np.zeros((1, 4, 4, VOCAB), np.float32)
+    onehot[..., rng.integers(0, VOCAB, (1, 4, 4))] = 1.0
+    with torch.no_grad():
+        ref = _nhwc(model(_nchw(onehot)))
+    dec = OpenAIDecoder(num_tokens=VOCAB, hidden=HID, blocks_per_group=BPG)
+    out = np.asarray(dec.apply({"params": params["decoder"]},
+                               jnp.asarray(onehot)))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)

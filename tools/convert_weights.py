@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""Convert released torch checkpoints -> this framework's msgpack params.
+
+Covers the pretrained models the reference downloads at runtime
+(`/root/reference/dalle_pytorch/vae.py:29-33`):
+
+* **Taming VQGAN f=16 / 1024 codes** (`vqgan.1024.model.ckpt`) -> params for
+  ``models.pretrained_vae.VQGanVAE1024`` (graphs mirror taming's topology,
+  so the mapping is 1:1 by name).
+* **OpenAI dVAE** (`encoder.pkl`/`decoder.pkl` from the DALL-E package) ->
+  params for ``models.pretrained_vae.OpenAIDiscreteVAE``.
+
+This environment has no network egress, so the real checkpoints cannot be
+fetched here — the name maps and tensor transforms are validated by unit
+tests that build torch twins of the graphs with the published naming
+(tests/test_weight_conversion.py) and compare forward passes numerically.
+
+Usage:
+  python tools/convert_weights.py vqgan --ckpt vqgan.1024.model.ckpt --out vqgan_jax.msgpack
+  python tools/convert_weights.py openai --encoder encoder.pkl --decoder decoder.pkl --out openai_jax.msgpack
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from dalle_pytorch_tpu.models.pretrained_vae import convert_conv_weight  # noqa: E402
+
+
+def _set(tree: dict, path: str, value: np.ndarray):
+    node = tree
+    parts = path.split("/")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def _conv(sd, key):
+    return convert_conv_weight(np.asarray(sd[key]))
+
+
+def _vec(sd, key):
+    return np.asarray(sd[key])
+
+
+# ---------------------------------------------------------------------------
+# Taming VQGAN (keys as in taming-transformers VQModel state_dict)
+# ---------------------------------------------------------------------------
+
+
+def _vq_resblock(params, sd, flax_prefix, torch_prefix, has_shortcut):
+    _set(params, f"{flax_prefix}/norm1/scale", _vec(sd, f"{torch_prefix}.norm1.weight"))
+    _set(params, f"{flax_prefix}/norm1/bias", _vec(sd, f"{torch_prefix}.norm1.bias"))
+    _set(params, f"{flax_prefix}/conv1/kernel", _conv(sd, f"{torch_prefix}.conv1.weight"))
+    _set(params, f"{flax_prefix}/conv1/bias", _vec(sd, f"{torch_prefix}.conv1.bias"))
+    _set(params, f"{flax_prefix}/norm2/scale", _vec(sd, f"{torch_prefix}.norm2.weight"))
+    _set(params, f"{flax_prefix}/norm2/bias", _vec(sd, f"{torch_prefix}.norm2.bias"))
+    _set(params, f"{flax_prefix}/conv2/kernel", _conv(sd, f"{torch_prefix}.conv2.weight"))
+    _set(params, f"{flax_prefix}/conv2/bias", _vec(sd, f"{torch_prefix}.conv2.bias"))
+    if has_shortcut:
+        _set(params, f"{flax_prefix}/nin_shortcut/kernel",
+             _conv(sd, f"{torch_prefix}.nin_shortcut.weight"))
+        _set(params, f"{flax_prefix}/nin_shortcut/bias",
+             _vec(sd, f"{torch_prefix}.nin_shortcut.bias"))
+
+
+def _vq_attnblock(params, sd, flax_prefix, torch_prefix):
+    _set(params, f"{flax_prefix}/norm/scale", _vec(sd, f"{torch_prefix}.norm.weight"))
+    _set(params, f"{flax_prefix}/norm/bias", _vec(sd, f"{torch_prefix}.norm.bias"))
+    for name in ("q", "k", "v", "proj_out"):
+        _set(params, f"{flax_prefix}/{name}/kernel",
+             _conv(sd, f"{torch_prefix}.{name}.weight"))
+        _set(params, f"{flax_prefix}/{name}/bias",
+             _vec(sd, f"{torch_prefix}.{name}.bias"))
+
+
+def convert_vqgan_state_dict(sd: dict, ch: int = 128,
+                             ch_mult=(1, 1, 2, 2, 4),
+                             num_res_blocks: int = 2) -> dict:
+    """taming VQModel state_dict -> VQGanVAE1024 params dict
+    ({encoder, decoder, codebook, quant_proj, post_quant_proj})."""
+    enc: dict = {}
+    _set(enc, "conv_in/kernel", _conv(sd, "encoder.conv_in.weight"))
+    _set(enc, "conv_in/bias", _vec(sd, "encoder.conv_in.bias"))
+    c_in = ch
+    for i, mult in enumerate(ch_mult):
+        c_out = ch * mult
+        for b in range(num_res_blocks):
+            _vq_resblock(enc, sd, f"down_{i}_block_{b}",
+                         f"encoder.down.{i}.block.{b}",
+                         has_shortcut=(c_in != c_out))
+            c_in = c_out
+        if i < len(ch_mult) - 1:
+            _set(enc, f"down_{i}_downsample/kernel",
+                 _conv(sd, f"encoder.down.{i}.downsample.conv.weight"))
+            _set(enc, f"down_{i}_downsample/bias",
+                 _vec(sd, f"encoder.down.{i}.downsample.conv.bias"))
+    _vq_resblock(enc, sd, "mid_block_1", "encoder.mid.block_1", False)
+    _vq_attnblock(enc, sd, "mid_attn_1", "encoder.mid.attn_1")
+    _vq_resblock(enc, sd, "mid_block_2", "encoder.mid.block_2", False)
+    _set(enc, "norm_out/scale", _vec(sd, "encoder.norm_out.weight"))
+    _set(enc, "norm_out/bias", _vec(sd, "encoder.norm_out.bias"))
+    _set(enc, "conv_out/kernel", _conv(sd, "encoder.conv_out.weight"))
+    _set(enc, "conv_out/bias", _vec(sd, "encoder.conv_out.bias"))
+
+    dec: dict = {}
+    _set(dec, "conv_in/kernel", _conv(sd, "decoder.conv_in.weight"))
+    _set(dec, "conv_in/bias", _vec(sd, "decoder.conv_in.bias"))
+    _vq_resblock(dec, sd, "mid_block_1", "decoder.mid.block_1", False)
+    _vq_attnblock(dec, sd, "mid_attn_1", "decoder.mid.attn_1")
+    _vq_resblock(dec, sd, "mid_block_2", "decoder.mid.block_2", False)
+    # taming's decoder.up is indexed by resolution level (0 = lowest mult);
+    # our decoder names up_{i} along its forward order (0 = highest mult)
+    n = len(ch_mult)
+    c_in = ch * ch_mult[-1]
+    for i, mult in enumerate(reversed(ch_mult)):
+        lvl = n - 1 - i
+        c_out = ch * mult
+        for b in range(num_res_blocks + 1):
+            _vq_resblock(dec, sd, f"up_{i}_block_{b}",
+                         f"decoder.up.{lvl}.block.{b}",
+                         has_shortcut=(c_in != c_out))
+            c_in = c_out
+        if i < n - 1:
+            _set(dec, f"up_{i}_upsample/kernel",
+                 _conv(sd, f"decoder.up.{lvl}.upsample.conv.weight"))
+            _set(dec, f"up_{i}_upsample/bias",
+                 _vec(sd, f"decoder.up.{lvl}.upsample.conv.bias"))
+    _set(dec, "norm_out/scale", _vec(sd, "decoder.norm_out.weight"))
+    _set(dec, "norm_out/bias", _vec(sd, "decoder.norm_out.bias"))
+    _set(dec, "conv_out/kernel", _conv(sd, "decoder.conv_out.weight"))
+    _set(dec, "conv_out/bias", _vec(sd, "decoder.conv_out.bias"))
+
+    def conv1x1_to_matrix(key):
+        w = np.asarray(sd[key])        # [out, in, 1, 1]
+        return np.squeeze(w, (2, 3)).T  # -> [in, out] matmul kernel
+
+    return {
+        "encoder": enc,
+        "decoder": dec,
+        "codebook": np.asarray(sd["quantize.embedding.weight"]),
+        "quant_proj": {"kernel": conv1x1_to_matrix("quant_conv.weight"),
+                       "bias": _vec(sd, "quant_conv.bias")},
+        "post_quant_proj": {"kernel": conv1x1_to_matrix("post_quant_conv.weight"),
+                            "bias": _vec(sd, "post_quant_conv.bias")},
+    }
+
+
+# ---------------------------------------------------------------------------
+# OpenAI dVAE (keys as in the DALL-E package's Encoder/Decoder: custom
+# Conv2d storing `w` [out, in, kh, kw] and `b`)
+# ---------------------------------------------------------------------------
+
+
+def _oai_block(params, sd, flax_prefix, torch_prefix, has_id_path):
+    for i in range(1, 5):
+        _set(params, f"{flax_prefix}/conv_{i}/kernel",
+             _conv(sd, f"{torch_prefix}.res_path.conv_{i}.w"))
+        _set(params, f"{flax_prefix}/conv_{i}/bias",
+             _vec(sd, f"{torch_prefix}.res_path.conv_{i}.b").reshape(-1))
+    if has_id_path:
+        _set(params, f"{flax_prefix}/id_path/kernel",
+             _conv(sd, f"{torch_prefix}.id_path.w"))
+        _set(params, f"{flax_prefix}/id_path/bias",
+             _vec(sd, f"{torch_prefix}.id_path.b").reshape(-1))
+
+
+def convert_openai_state_dicts(enc_sd: dict, dec_sd: dict | None,
+                               hidden: int = 256,
+                               blocks_per_group: int = 2) -> dict:
+    """DALL-E package encoder/decoder state_dicts -> OpenAIDiscreteVAE
+    params ({encoder, decoder}); `dec_sd=None` converts the encoder only."""
+    enc: dict = {}
+    _set(enc, "stem/kernel", _conv(enc_sd, "blocks.input.w"))
+    _set(enc, "stem/bias", _vec(enc_sd, "blocks.input.b").reshape(-1))
+    prev = hidden
+    for g, mult in enumerate((1, 2, 4, 8)):
+        n_out = hidden * mult
+        for b in range(blocks_per_group):
+            _oai_block(enc, enc_sd, f"group_{g}_block_{b}",
+                       f"blocks.group_{g + 1}.block_{b + 1}",
+                       has_id_path=(prev != n_out))
+            prev = n_out
+    _set(enc, "head/kernel", _conv(enc_sd, "blocks.output.conv.w"))
+    _set(enc, "head/bias", _vec(enc_sd, "blocks.output.conv.b").reshape(-1))
+    if dec_sd is None:
+        return {"encoder": enc}
+
+    dec: dict = {}
+    _set(dec, "stem/kernel", _conv(dec_sd, "blocks.input.w"))
+    _set(dec, "stem/bias", _vec(dec_sd, "blocks.input.b").reshape(-1))
+    prev = hidden // 2  # n_init
+    for g, mult in enumerate((8, 4, 2, 1)):
+        n_out = hidden * mult
+        for b in range(blocks_per_group):
+            _oai_block(dec, dec_sd, f"group_{g}_block_{b}",
+                       f"blocks.group_{g + 1}.block_{b + 1}",
+                       has_id_path=(prev != n_out))
+            prev = n_out
+    _set(dec, "head/kernel", _conv(dec_sd, "blocks.output.conv.w"))
+    _set(dec, "head/bias", _vec(dec_sd, "blocks.output.conv.b").reshape(-1))
+    return {"encoder": enc, "decoder": dec}
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _torch_load(path):
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    return {k: v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+            for k, v in obj.items()}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_vq = sub.add_parser("vqgan")
+    p_vq.add_argument("--ckpt", required=True)
+    p_vq.add_argument("--out", required=True)
+
+    p_oa = sub.add_parser("openai")
+    p_oa.add_argument("--encoder", required=True)
+    p_oa.add_argument("--decoder", required=True)
+    p_oa.add_argument("--out", required=True)
+
+    args = parser.parse_args(argv)
+    from dalle_pytorch_tpu.utils.checkpoint import save_checkpoint
+
+    if args.cmd == "vqgan":
+        params = convert_vqgan_state_dict(_torch_load(args.ckpt))
+    else:
+        params = convert_openai_state_dicts(_torch_load(args.encoder),
+                                            _torch_load(args.decoder))
+    save_checkpoint(args.out, params)
+    n = sum(np.asarray(v).size for v in _leaves(params))
+    print(f"wrote {args.out}: {n / 1e6:.1f}M params")
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _leaves(v)
+    else:
+        yield tree
+
+
+if __name__ == "__main__":
+    main()

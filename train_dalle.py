@@ -297,6 +297,9 @@ def main(argv=None):
             payload['vae_weights'] = vae_weights
         save_checkpoint(path, payload)
 
+    from dalle_pytorch_tpu.utils.profiling import StepTimer, dalle_train_flops
+
+    timer = StepTimer(flops_per_step=dalle_train_flops(dalle_cfg, BATCH_SIZE))
     lr = sched.lr
     global_step = 0
     t0 = time.perf_counter()
@@ -308,9 +311,11 @@ def main(argv=None):
             params, opt_state, loss = train_step(
                 params, opt_state, vae_params, text_b, images_b, step_rng)
 
+            # average_all syncs on the loss, so the timer sees real step time
             avg_loss = float(distr_backend.average_all(loss))
+            perf = timer.tick(BATCH_SIZE)
             epoch_losses.append(avg_loss)
-            logger.step(epoch, i, avg_loss, lr)
+            logger.step(epoch, i, avg_loss, lr, extra=perf)
 
             if i % 100 == 0:
                 # periodic sample (ref :396-412): SPMD computation, so every
