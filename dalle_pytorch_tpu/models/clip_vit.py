@@ -1,0 +1,176 @@
+"""Faithful OpenAI CLIP (ViT-B/32) inference graph in JAX.
+
+The reference's eval harness ranks generated images with the *official*
+OpenAI CLIP ViT-B/32 torch package (`/root/reference/genrank.py:20-22,
+:68-77`) — a different model from the trainable lucidrains-style `CLIP` in
+``models/clip.py``.  This module is a 1:1 JAX graph of the published
+architecture so the released weights can be converted
+(`tools/convert_weights.py clip`) and used for re-ranking on TPU:
+
+* visual: 32x32 patch conv (no bias) -> class token + positional embedding
+  -> ln_pre -> 12x ResidualAttentionBlock (pre-LN, quick-gelu MLP) ->
+  ln_post on the class token -> projection;
+* text: token + positional embeddings -> 12x causal blocks -> ln_final ->
+  features at the EOT (argmax token id) position -> text projection;
+* similarity: L2-normalized features, learned exp logit scale.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..utils.helpers import l2norm
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPViTConfig:
+    """ViT-B/32 defaults (the published clip.load('ViT-B/32') geometry)."""
+
+    image_size: int = 224
+    patch_size: int = 32
+    vision_width: int = 768
+    vision_layers: int = 12
+    vision_heads: int = 12
+    embed_dim: int = 512
+    text_width: int = 512
+    text_layers: int = 12
+    text_heads: int = 8
+    context_length: int = 77
+    vocab_size: int = 49408
+    dtype: Any = jnp.float32
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("dtype")
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict, **overrides) -> "CLIPViTConfig":
+        d = dict(d)
+        d.update(overrides)
+        return cls(**d)
+
+
+class ResidualAttentionBlock(nn.Module):
+    """Pre-LN block matching torch CLIP's ResidualAttentionBlock (ln_1 ->
+    MultiheadAttention -> ln_2 -> quickgelu MLP)."""
+
+    width: int
+    heads: int
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        w = self.width
+        self.ln_1 = nn.LayerNorm(dtype=jnp.float32, name="ln_1")
+        self.ln_2 = nn.LayerNorm(dtype=jnp.float32, name="ln_2")
+        self.in_proj = nn.Dense(3 * w, dtype=self.dtype, name="in_proj")
+        self.out_proj = nn.Dense(w, dtype=self.dtype, name="out_proj")
+        self.c_fc = nn.Dense(4 * w, dtype=self.dtype, name="c_fc")
+        self.c_proj = nn.Dense(w, dtype=self.dtype, name="c_proj")
+
+    def _attend(self, x):
+        b, n, w = x.shape
+        dh = w // self.heads
+        qkv = self.in_proj(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(b, n, self.heads, dh).transpose(0, 2, 1, 3)
+        q, k, v = split(q), split(k), split(v)
+        s = jnp.einsum("bhid,bhjd->bhij", q, k,
+                       preferred_element_type=jnp.float32) * dh ** -0.5
+        if self.causal:
+            mask = jnp.tril(jnp.ones((n, n), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        a = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhij,bhjd->bhid", a, v)
+        o = o.transpose(0, 2, 1, 3).reshape(b, n, w)
+        return self.out_proj(o)
+
+    def __call__(self, x):
+        x = x + self._attend(self.ln_1(x).astype(x.dtype))
+        h = self.c_fc(self.ln_2(x).astype(x.dtype))
+        x = x + self.c_proj(quick_gelu(h))
+        return x
+
+
+class CLIPViT(nn.Module):
+    """Inference-only OpenAI CLIP graph (weights converted from torch)."""
+
+    cfg: CLIPViTConfig
+
+    def setup(self):
+        cfg = self.cfg
+        grid = cfg.image_size // cfg.patch_size
+        init = nn.initializers.normal(0.02)
+        self.conv1 = nn.Conv(cfg.vision_width,
+                             (cfg.patch_size, cfg.patch_size),
+                             strides=cfg.patch_size, use_bias=False,
+                             padding="VALID", dtype=cfg.dtype, name="conv1")
+        self.class_embedding = self.param("class_embedding", init,
+                                          (cfg.vision_width,))
+        self.vision_pos = self.param("vision_pos", init,
+                                     (grid * grid + 1, cfg.vision_width))
+        self.ln_pre = nn.LayerNorm(dtype=jnp.float32, name="ln_pre")
+        self.vision_blocks = [
+            ResidualAttentionBlock(cfg.vision_width, cfg.vision_heads,
+                                   dtype=cfg.dtype, name=f"vision_block_{i}")
+            for i in range(cfg.vision_layers)]
+        self.ln_post = nn.LayerNorm(dtype=jnp.float32, name="ln_post")
+        self.vision_proj = self.param("vision_proj", init,
+                                      (cfg.vision_width, cfg.embed_dim))
+
+        self.token_embedding = nn.Embed(cfg.vocab_size, cfg.text_width,
+                                        embedding_init=init,
+                                        name="token_embedding")
+        self.text_pos = self.param("text_pos", init,
+                                   (cfg.context_length, cfg.text_width))
+        self.text_blocks = [
+            ResidualAttentionBlock(cfg.text_width, cfg.text_heads,
+                                   causal=True, dtype=cfg.dtype,
+                                   name=f"text_block_{i}")
+            for i in range(cfg.text_layers)]
+        self.ln_final = nn.LayerNorm(dtype=jnp.float32, name="ln_final")
+        self.text_projection = self.param("text_projection", init,
+                                          (cfg.text_width, cfg.embed_dim))
+        self.logit_scale = self.param("logit_scale",
+                                      nn.initializers.constant(4.6052), ())
+
+    def encode_image(self, image):
+        """image: [b, H, W, 3], CLIP-normalized. -> [b, embed_dim]."""
+        x = self.conv1(image)                    # [b, g, g, w]
+        b, g1, g2, w = x.shape
+        x = x.reshape(b, g1 * g2, w)
+        cls = jnp.broadcast_to(self.class_embedding, (b, 1, w)).astype(x.dtype)
+        x = jnp.concatenate([cls, x], axis=1) + self.vision_pos
+        x = self.ln_pre(x).astype(x.dtype)
+        for blk in self.vision_blocks:
+            x = blk(x)
+        pooled = self.ln_post(x[:, 0]).astype(jnp.float32)
+        return pooled @ self.vision_proj
+
+    def encode_text(self, text):
+        """text: [b, context_length] int tokens. -> [b, embed_dim]."""
+        x = self.token_embedding(text) + self.text_pos[: text.shape[1]]
+        x = x.astype(self.cfg.dtype)
+        for blk in self.text_blocks:
+            x = blk(x)
+        x = self.ln_final(x).astype(jnp.float32)
+        eot = jnp.argmax(text, axis=-1)          # EOT has the largest id
+        pooled = jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+        return pooled @ self.text_projection
+
+    def __call__(self, text, image):
+        """-> (logits_per_text [bt, bi], logits_per_image [bi, bt])."""
+        t = l2norm(self.encode_text(text))
+        i = l2norm(self.encode_image(image))
+        scale = jnp.exp(self.logit_scale)
+        logits_per_text = scale * t @ i.T
+        return logits_per_text, logits_per_text.T

@@ -14,12 +14,18 @@ from typing import Optional
 
 import jax
 
-# peak dense bf16 FLOP/s per chip by device kind substring (public numbers)
+# peak dense bf16 FLOP/s per chip by device kind substring (public numbers).
+# Order matters: 'lite' variants must match before the bare generation
+# (libtpu reports e.g. 'TPU v5 lite' for v5e but 'TPU v5' for v5p,
+# 'TPU v6 lite' for v6e).
 PEAK_FLOPS = (
     ("v5 lite", 197e12),   # v5e
     ("v5e", 197e12),
-    ("v5p", 459e12),
+    ("v6 lite", 918e12),   # v6e (Trillium)
     ("v6e", 918e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),        # bare 'TPU v5' = v5p
+    ("v6", 918e12),
     ("v4", 275e12),
     ("v3", 123e12),
     ("v2", 45e12),

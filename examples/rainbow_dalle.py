@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Rainbow DALL-E — runnable end-to-end example on synthetic shapes.
+
+Script port of the reference's `examples/rainbow_dalle.ipynb`: render a
+synthetic dataset of colored shapes with word captions, train a
+DiscreteVAE, train a DALLE on top, then greedily generate one image per
+caption class and report token-level accuracy — the whole text-to-image
+story on one chip (CPU works too) in a few minutes.
+
+Usage: python examples/rainbow_dalle.py [--steps-vae 800] [--steps-dalle 400]
+                                        [--out rainbow_out]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from dalle_pytorch_tpu import DALLE, DALLEConfig, DiscreteVAE, VAEConfig  # noqa: E402
+from dalle_pytorch_tpu.models.dalle import generate_codes  # noqa: E402
+from dalle_pytorch_tpu.training import (make_dalle_train_step,  # noqa: E402
+                                        make_optimizer, make_vae_train_step)
+from dalle_pytorch_tpu.utils.images import save_image_grid  # noqa: E402
+
+SIZE = 32
+COLORS = {"red": (0.9, 0.1, 0.1), "green": (0.1, 0.8, 0.1),
+          "blue": (0.1, 0.2, 0.9), "yellow": (0.9, 0.85, 0.1)}
+SHAPES = ["square", "circle", "stripe", "cross"]
+VOCAB = {w: i + 1 for i, w in enumerate(list(COLORS) + SHAPES)}  # 0 = pad
+
+
+def render(color: str, shape: str) -> np.ndarray:
+    img = np.ones((SIZE, SIZE, 3), np.float32)
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE]
+    c = np.asarray(COLORS[color], np.float32)
+    mid, r = SIZE // 2, SIZE // 3
+    if shape == "square":
+        m = (yy >= SIZE // 5) & (yy < SIZE - SIZE // 5) & \
+            (xx >= SIZE // 5) & (xx < SIZE - SIZE // 5)
+    elif shape == "circle":
+        m = (yy - mid + 0.5) ** 2 + (xx - mid + 0.5) ** 2 <= r ** 2
+    elif shape == "stripe":
+        m = (yy >= mid - 3) & (yy < mid + 3)
+    else:  # cross
+        m = ((yy >= mid - 3) & (yy < mid + 3)) | \
+            ((xx >= mid - 3) & (xx < mid + 3))
+    img[m] = c
+    return img
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps-vae", type=int, default=800)
+    parser.add_argument("--steps-dalle", type=int, default=400)
+    parser.add_argument("--out", type=str, default="rainbow_out")
+    args = parser.parse_args(argv)
+
+    classes = [(c, s) for c in COLORS for s in SHAPES]
+    rng_np = np.random.default_rng(0)
+    key = jax.random.PRNGKey(0)
+
+    def make_batch(n):
+        text = np.zeros((n, 2), np.int32)
+        imgs = np.zeros((n, SIZE, SIZE, 3), np.float32)
+        for i in range(n):
+            c, s = classes[int(rng_np.integers(len(classes)))]
+            text[i] = (VOCAB[c], VOCAB[s])
+            imgs[i] = render(c, s)
+        imgs += rng_np.uniform(0, 0.03, imgs.shape).astype(np.float32)
+        return text, np.clip(imgs, 0, 1)
+
+    # ----- stage 1: DiscreteVAE -----
+    vae_cfg = VAEConfig(image_size=SIZE, num_tokens=64, codebook_dim=64,
+                        num_layers=2, hidden_dim=32, num_resnet_blocks=1)
+    vae = DiscreteVAE(vae_cfg)
+    key, k = jax.random.split(key)
+    vparams = vae.init({"params": k, "gumbel": k},
+                       jnp.zeros((1, SIZE, SIZE, 3)))["params"]
+    vtx = make_optimizer(2e-3)
+    vopt = jax.jit(vtx.init)(vparams)
+    vstep = make_vae_train_step(vae, vtx)
+    t0 = time.time()
+    for step in range(args.steps_vae):
+        _, imgs = make_batch(16)
+        key, k = jax.random.split(key)
+        temp = max(np.exp(-4e-3 * step), 0.5)
+        vparams, vopt, vloss, _ = vstep(vparams, vopt, jnp.asarray(imgs), k,
+                                        jnp.asarray(temp, jnp.float32))
+        if step % 100 == 0:
+            print(f"vae step {step}: loss {float(vloss):.4f}")
+    print(f"vae trained in {time.time() - t0:.0f}s, final loss {float(vloss):.4f}")
+
+    # ----- stage 2: DALLE -----
+    dalle_cfg = DALLEConfig.from_vae(
+        vae_cfg, dim=128, num_text_tokens=len(VOCAB) + 1, text_seq_len=2,
+        depth=4, heads=4, dim_head=32,
+        attn_types=("full", "axial_row", "axial_col", "conv_like"))
+    dalle = DALLE(dalle_cfg)
+    key, k = jax.random.split(key)
+    dparams = dalle.init(k, jnp.zeros((1, 2), jnp.int32),
+                         jnp.zeros((1, dalle_cfg.image_seq_len),
+                                   jnp.int32))["params"]
+    dtx = make_optimizer(1e-3)
+    dopt = jax.jit(dtx.init)(dparams)
+    dstep = make_dalle_train_step(dalle, dtx, vae=vae)
+    t0 = time.time()
+    for step in range(args.steps_dalle):
+        text, imgs = make_batch(16)
+        key, k = jax.random.split(key)
+        dparams, dopt, dloss = dstep(dparams, dopt, vparams,
+                                     jnp.asarray(text), jnp.asarray(imgs), k)
+        if step % 100 == 0:
+            print(f"dalle step {step}: loss {float(dloss):.4f}")
+    print(f"dalle trained in {time.time() - t0:.0f}s, final loss {float(dloss):.4f}")
+
+    # ----- generation + accuracy (notebook cells 32-37) -----
+    greedy = 1.0 - 1.0 / dalle_cfg.total_tokens
+    accs, images = [], []
+    for c, s in classes:
+        text = jnp.asarray([[VOCAB[c], VOCAB[s]]], jnp.int32)
+        key, k = jax.random.split(key)
+        codes = generate_codes(dalle, {"params": dparams}, text, k,
+                               filter_thres=greedy)
+        target = vae.apply({"params": vparams}, jnp.asarray(render(c, s))[None],
+                           method=DiscreteVAE.get_codebook_indices)
+        accs.append(float((np.asarray(codes) == np.asarray(target)).mean()))
+        images.append(np.asarray(
+            vae.apply({"params": vparams}, codes, method=DiscreteVAE.decode))[0])
+        print(f"{c:7s} {s:7s}: per-position token accuracy {accs[-1]:.2f}")
+
+    out = Path(args.out)
+    save_image_grid(out / "generated.png", np.stack(images))
+    save_image_grid(out / "targets.png",
+                    np.stack([render(c, s) for c, s in classes]))
+    print(f"mean per-position accuracy {np.mean(accs):.3f} "
+          f"(reference notebook reports >0.8 after longer training)")
+    print(f"grids written to {out}/")
+
+
+if __name__ == "__main__":
+    main()

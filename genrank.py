@@ -43,8 +43,14 @@ def parse_args(argv=None):
     parser.add_argument('--num_images', type=int, default=16)
     parser.add_argument('--bpe_path', type=str, default=DEFAULT_BPE)
     parser.add_argument('--clip_path', type=str, default=None,
-                        help='checkpoint of a JAX CLIP ranker '
-                             '({hparams, weights}); omit to skip ranking')
+                        help='checkpoint of a JAX CLIP ranker ({hparams, '
+                             'weights}): a trained models.clip.CLIP or a '
+                             'converted official OpenAI CLIP ViT '
+                             '(tools/convert_weights.py clip); omit to '
+                             'skip ranking')
+    parser.add_argument('--clip_bpe_path', type=str, default=None,
+                        help='CLIP merges txt (bpe_simple_vocab_16e6.txt), '
+                             'required with a converted OpenAI CLIP ranker')
     parser.add_argument('--taming', action='store_true')
     return parser.parse_args(argv)
 
@@ -96,12 +102,23 @@ _CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
 _CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
 
 
-def clip_ranking(clip_model, clip_params, tokenizer, images, caption):
-    """Softmax probs + raw logits_per_text over the candidates (ref :68-77)."""
-    size = clip_model.cfg.visual_image_size
+def _softmax(logits):
+    probs = np.exp(logits - logits.max())
+    return probs / probs.sum()
+
+
+def _preprocess(images, size):
+    """Resize to the ranker's input size + CLIP normalization (ref :68-71:
+    F.interpolate to 224 + the official preprocess normalization)."""
     ims = jax.image.resize(jnp.asarray(images),
                            (images.shape[0], size, size, 3), 'bilinear')
-    ims = (ims - _CLIP_MEAN) / _CLIP_STD
+    return (ims - _CLIP_MEAN) / _CLIP_STD
+
+
+def clip_ranking(clip_model, clip_params, tokenizer, images, caption):
+    """Softmax probs + raw logits_per_text over the candidates (ref :68-77)
+    using the trainable CLIP (models/clip.py)."""
+    ims = _preprocess(images, clip_model.cfg.visual_image_size)
     text = tokenizer.tokenize([caption], clip_model.cfg.text_seq_len,
                               truncate_text=True)
     text = jnp.asarray(text, jnp.int32)
@@ -116,9 +133,34 @@ def clip_ranking(clip_model, clip_params, tokenizer, images, caption):
         return (text_lat @ img_lat.T) * temp  # [1, n] logits_per_text
 
     logits = np.asarray(jax.device_get(score(clip_params, text, ims)))[0]
-    probs = np.exp(logits - logits.max())
-    probs = probs / probs.sum()
-    return probs, logits
+    return _softmax(logits), logits
+
+
+def clip_vit_ranking(clip_model, clip_params, images, caption,
+                     clip_bpe_path):
+    """Ranking with the converted official OpenAI CLIP ViT
+    (models/clip_vit.py + tools/convert_weights.py clip) — the reference's
+    actual ranker (genrank.py:20-22).  Text goes through the CLIP BPE with
+    <|startoftext|>/<|endoftext|> wrapping, as `clip.tokenize` does."""
+    from dalle_pytorch_tpu.data.tokenizer import SimpleTokenizer
+
+    cfg = clip_model.cfg
+    tok = SimpleTokenizer(clip_bpe_path)
+    ids = [tok.encoder[tok.SOT]] + tok.encode(caption)[: cfg.context_length - 2]
+    ids.append(tok.encoder[tok.EOT])
+    text = np.zeros((1, cfg.context_length), np.int32)
+    text[0, : len(ids)] = ids
+
+    ims = _preprocess(images, cfg.image_size)
+
+    @jax.jit
+    def score(params, text, ims):
+        logits_per_text, _ = clip_model.apply({'params': params}, text, ims)
+        return logits_per_text
+
+    logits = np.asarray(jax.device_get(
+        score(clip_params, jnp.asarray(text), ims)))[0]
+    return _softmax(logits), logits
 
 
 def show_reranking(images, scores, logits, sort=True, cols_wide=4):
@@ -149,7 +191,7 @@ def show_reranking(images, scores, logits, sort=True, cols_wide=4):
 
 
 def get_model_output(dalle_path, out_path, text, num_images, bpe_path,
-                     clip_path, taming):
+                     clip_path, taming, clip_bpe_path=None):
     ims, tokenizer = generate_images(dalle_path, text, num_images, BATCH_SIZE,
                                      TOP_K, bpe_path, taming)
     folder = f'{out_path}/{Path(dalle_path).name[:-3]}'
@@ -158,11 +200,23 @@ def get_model_output(dalle_path, out_path, text, num_images, bpe_path,
 
     if clip_path is not None:
         ckpt = load_checkpoint(clip_path)
-        clip_cfg = CLIPConfig.from_dict(dict(ckpt['hparams']))
-        clip_model = CLIP(clip_cfg)
+        hparams = dict(ckpt['hparams'])
         clip_params = jax.tree.map(jnp.asarray, ckpt['weights'])
-        probs, logits = clip_ranking(clip_model, clip_params, tokenizer,
-                                     reread, text)
+        if 'vision_width' in hparams:
+            # converted official OpenAI CLIP ViT (convert_weights.py clip)
+            from dalle_pytorch_tpu.models.clip_vit import CLIPViT, CLIPViTConfig
+
+            clip_model = CLIPViT(CLIPViTConfig.from_dict(hparams))
+            if clip_bpe_path is None:
+                raise SystemExit(
+                    '--clip_bpe_path (the CLIP merges txt) is required with '
+                    'a converted OpenAI CLIP ranker')
+            probs, logits = clip_vit_ranking(clip_model, clip_params, reread,
+                                             text, clip_bpe_path)
+        else:
+            clip_model = CLIP(CLIPConfig.from_dict(hparams))
+            probs, logits = clip_ranking(clip_model, clip_params, tokenizer,
+                                         reread, text)
     else:
         print('no --clip_path: skipping CLIP ranking, recording unranked order')
         probs = np.full((num_images,), 1.0 / num_images, np.float32)
@@ -183,7 +237,8 @@ def main(argv=None):
 
     figs, probs, logits = get_model_output(
         args.dalle_path, args.out_path, args.text, args.num_images,
-        args.bpe_path, args.clip_path, args.taming)
+        args.bpe_path, args.clip_path, args.taming,
+        clip_bpe_path=args.clip_bpe_path)
 
     fname = out_path / f'B{mname}'
     np.save(fname, logits)

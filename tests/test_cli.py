@@ -183,6 +183,50 @@ def test_generate_cli(trained_dalle, tiny_tokenizer_json, workdir):
     assert len(jpgs) == 2
 
 
+def test_genrank_cli_with_clip_vit(trained_dalle, tiny_tokenizer_json,
+                                   workdir):
+    """Ranking through a converted-official-CLIP-style (CLIPViT) ranker."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.models.clip_vit import CLIPViT, CLIPViTConfig
+    from dalle_pytorch_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = CLIPViTConfig(image_size=16, patch_size=8, vision_width=32,
+                        vision_layers=2, vision_heads=4, embed_dim=16,
+                        text_width=32, text_layers=2, text_heads=4,
+                        context_length=8, vocab_size=600)
+    clip = CLIPViT(cfg)
+    params = clip.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 8), jnp.int32),
+                       jnp.zeros((1, 16, 16, 3)))["params"]
+    save_checkpoint(workdir / "clip_vit.pt",
+                    {"hparams": cfg.to_dict(), "weights": params})
+
+    # tiny CLIP merges file (same format as tests/test_tokenizer.py)
+    merges = ["#version: test", "r e", "re d", "b i", "bi rd"]
+    (workdir / "clip_merges.txt").write_text("\n".join(merges) + "\n")
+
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        import genrank
+
+        genrank.main(["--dalle_path", str(trained_dalle),
+                      "--text", "red bird",
+                      "--num_images", "4",
+                      "--bpe_path", str(tiny_tokenizer_json),
+                      "--clip_path", str(workdir / "clip_vit.pt"),
+                      "--clip_bpe_path", str(workdir / "clip_merges.txt"),
+                      "--out_path", str(workdir / "rank_vit_out")])
+    finally:
+        os.chdir(cwd)
+    results = (workdir / "rank_vit_out" / "results.txt").read_text().strip()
+    mname, mean, std = results.split(" ")
+    # a real ranker produces non-degenerate logits
+    assert float(std) >= 0.0 and mean not in ("nan", "0.0")
+
+
 def test_genrank_cli(trained_dalle, tiny_tokenizer_json, workdir):
     cwd = os.getcwd()
     os.chdir(workdir)

@@ -21,7 +21,8 @@ import torch.nn as tnn  # noqa: E402
 import torch.nn.functional as F  # noqa: E402
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-from tools.convert_weights import (convert_openai_state_dicts,  # noqa: E402
+from tools.convert_weights import (convert_clip_state_dict,  # noqa: E402
+                                   convert_openai_state_dicts,
                                    convert_vqgan_state_dict)
 
 CH, CH_MULT, NRES, Z = 32, (1, 2), 1, 32
@@ -338,3 +339,117 @@ def test_openai_decoder_conversion():
     out = np.asarray(dec.apply({"params": params["decoder"]},
                                jnp.asarray(onehot)))
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# torch twin of OpenAI CLIP ViT (the released clip package's naming)
+# ---------------------------------------------------------------------------
+
+
+class TClipBlock(tnn.Module):
+    def __init__(self, width, heads, causal):
+        super().__init__()
+        self.ln_1 = tnn.LayerNorm(width)
+        self.attn = tnn.MultiheadAttention(width, heads, batch_first=True)
+        self.ln_2 = tnn.LayerNorm(width)
+        self.mlp = tnn.Sequential(OrderedDict([
+            ("c_fc", tnn.Linear(width, 4 * width)),
+            ("gelu", tnn.Identity()),  # quickgelu applied manually
+            ("c_proj", tnn.Linear(4 * width, width)),
+        ]))
+        self.causal = causal
+        self.width = width
+
+    def forward(self, x):
+        n = x.shape[1]
+        mask = None
+        if self.causal:
+            mask = torch.full((n, n), float("-inf")).triu(1)
+        h = self.ln_1(x)
+        a, _ = self.attn(h, h, h, need_weights=False, attn_mask=mask)
+        x = x + a
+        h = self.mlp.c_fc(self.ln_2(x))
+        h = h * torch.sigmoid(1.702 * h)  # quick gelu
+        return x + self.mlp.c_proj(h)
+
+
+def test_clip_vit_conversion():
+    from dalle_pytorch_tpu.models.clip_vit import CLIPViT, CLIPViTConfig
+
+    W, HEADS, LAYERS, PATCH, IMG, VOCAB, CTX, EMB = 32, 4, 2, 8, 16, 50, 8, 16
+    torch.manual_seed(5)
+
+    class TClip(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            grid = IMG // PATCH
+            v = _Holder()
+            v.conv1 = tnn.Conv2d(3, W, PATCH, stride=PATCH, bias=False)
+            v.class_embedding = tnn.Parameter(torch.randn(W) * 0.1)
+            v.positional_embedding = tnn.Parameter(
+                torch.randn(grid * grid + 1, W) * 0.1)
+            v.ln_pre = tnn.LayerNorm(W)
+            vt = _Holder()
+            vt.resblocks = tnn.ModuleList(
+                [TClipBlock(W, HEADS, False) for _ in range(LAYERS)])
+            v.transformer = vt
+            v.ln_post = tnn.LayerNorm(W)
+            v.proj = tnn.Parameter(torch.randn(W, EMB) * 0.1)
+            self.visual = v
+            self.token_embedding = tnn.Embedding(VOCAB, W)
+            self.positional_embedding = tnn.Parameter(torch.randn(CTX, W) * 0.1)
+            t = _Holder()
+            t.resblocks = tnn.ModuleList(
+                [TClipBlock(W, HEADS, True) for _ in range(LAYERS)])
+            self.transformer = t
+            self.ln_final = tnn.LayerNorm(W)
+            self.text_projection = tnn.Parameter(torch.randn(W, EMB) * 0.1)
+            self.logit_scale = tnn.Parameter(torch.tensor(2.0))
+
+        def encode_image(self, x):
+            v = self.visual
+            h = v.conv1(x).flatten(2).permute(0, 2, 1)
+            cls = v.class_embedding[None, None].expand(h.shape[0], 1, -1)
+            h = torch.cat([cls, h], 1) + v.positional_embedding
+            h = v.ln_pre(h)
+            for blk in v.transformer.resblocks:
+                h = blk(h)
+            return v.ln_post(h[:, 0]) @ v.proj
+
+        def encode_text(self, text):
+            h = self.token_embedding(text) + self.positional_embedding[: text.shape[1]]
+            for blk in self.transformer.resblocks:
+                h = blk(h)
+            h = self.ln_final(h)
+            eot = text.argmax(dim=-1)
+            return h[torch.arange(h.shape[0]), eot] @ self.text_projection
+
+    model = TClip()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    params = convert_clip_state_dict(sd, vision_layers=LAYERS,
+                                     text_layers=LAYERS)
+
+    cfg = CLIPViTConfig(image_size=IMG, patch_size=PATCH, vision_width=W,
+                        vision_layers=LAYERS, vision_heads=HEADS,
+                        embed_dim=EMB, text_width=W, text_layers=LAYERS,
+                        text_heads=HEADS, context_length=CTX,
+                        vocab_size=VOCAB)
+    clip = CLIPViT(cfg)
+
+    rng = np.random.default_rng(6)
+    img = rng.normal(size=(2, IMG, IMG, 3)).astype(np.float32)
+    text = np.zeros((2, CTX), np.int64)
+    text[0, :4] = [5, 10, 3, 49]  # 49 = max id = EOT
+    text[1, :3] = [7, 2, 49]
+
+    with torch.no_grad():
+        ref_i = model.encode_image(_nchw(img)).numpy()
+        ref_t = model.encode_text(torch.from_numpy(text)).numpy()
+
+    out_i = np.asarray(clip.apply({"params": params}, jnp.asarray(img),
+                                  method=CLIPViT.encode_image))
+    out_t = np.asarray(clip.apply({"params": params},
+                                  jnp.asarray(text, jnp.int32),
+                                  method=CLIPViT.encode_text))
+    np.testing.assert_allclose(out_i, ref_i, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out_t, ref_t, rtol=1e-4, atol=1e-4)

@@ -208,6 +208,85 @@ def convert_openai_state_dicts(enc_sd: dict, dec_sd: dict | None,
 
 
 # ---------------------------------------------------------------------------
+# OpenAI CLIP ViT (keys as in the released clip package state_dict)
+# ---------------------------------------------------------------------------
+
+
+def _clip_block(params, sd, flax_prefix, torch_prefix):
+    _set(params, f"{flax_prefix}/ln_1/scale", _vec(sd, f"{torch_prefix}.ln_1.weight"))
+    _set(params, f"{flax_prefix}/ln_1/bias", _vec(sd, f"{torch_prefix}.ln_1.bias"))
+    _set(params, f"{flax_prefix}/ln_2/scale", _vec(sd, f"{torch_prefix}.ln_2.weight"))
+    _set(params, f"{flax_prefix}/ln_2/bias", _vec(sd, f"{torch_prefix}.ln_2.bias"))
+    # torch MultiheadAttention packs qkv as in_proj_weight [3w, w]
+    _set(params, f"{flax_prefix}/in_proj/kernel",
+         np.asarray(sd[f"{torch_prefix}.attn.in_proj_weight"]).T)
+    _set(params, f"{flax_prefix}/in_proj/bias",
+         _vec(sd, f"{torch_prefix}.attn.in_proj_bias"))
+    _set(params, f"{flax_prefix}/out_proj/kernel",
+         np.asarray(sd[f"{torch_prefix}.attn.out_proj.weight"]).T)
+    _set(params, f"{flax_prefix}/out_proj/bias",
+         _vec(sd, f"{torch_prefix}.attn.out_proj.bias"))
+    _set(params, f"{flax_prefix}/c_fc/kernel",
+         np.asarray(sd[f"{torch_prefix}.mlp.c_fc.weight"]).T)
+    _set(params, f"{flax_prefix}/c_fc/bias", _vec(sd, f"{torch_prefix}.mlp.c_fc.bias"))
+    _set(params, f"{flax_prefix}/c_proj/kernel",
+         np.asarray(sd[f"{torch_prefix}.mlp.c_proj.weight"]).T)
+    _set(params, f"{flax_prefix}/c_proj/bias",
+         _vec(sd, f"{torch_prefix}.mlp.c_proj.bias"))
+
+
+def infer_clip_config(sd: dict) -> dict:
+    """Geometry of a released CLIP ViT state_dict (for CLIPViTConfig)."""
+    conv1 = np.asarray(sd["visual.conv1.weight"])  # [w, 3, p, p]
+    vision_width, _, patch, _ = conv1.shape
+    grid_plus1 = np.asarray(sd["visual.positional_embedding"]).shape[0]
+    grid = int(np.sqrt(grid_plus1 - 1))
+    vision_layers = 1 + max(
+        int(k.split(".")[3]) for k in sd if k.startswith("visual.transformer.resblocks."))
+    text_layers = 1 + max(
+        int(k.split(".")[2]) for k in sd
+        if k.startswith("transformer.resblocks."))
+    vocab, text_width = np.asarray(sd["token_embedding.weight"]).shape
+    embed_dim = np.asarray(sd["text_projection"]).shape[1]
+    return dict(
+        image_size=grid * patch, patch_size=patch,
+        vision_width=vision_width, vision_layers=vision_layers,
+        vision_heads=vision_width // 64, embed_dim=embed_dim,
+        text_width=text_width, text_layers=text_layers,
+        text_heads=text_width // 64,
+        context_length=np.asarray(sd["positional_embedding"]).shape[0],
+        vocab_size=vocab)
+
+
+def convert_clip_state_dict(sd: dict, vision_layers: int = 12,
+                            text_layers: int = 12) -> dict:
+    """Released OpenAI CLIP (ViT) state_dict -> models.clip_vit.CLIPViT
+    params."""
+    p: dict = {}
+    _set(p, "conv1/kernel", _conv(sd, "visual.conv1.weight"))
+    _set(p, "class_embedding", _vec(sd, "visual.class_embedding"))
+    _set(p, "vision_pos", _vec(sd, "visual.positional_embedding"))
+    _set(p, "ln_pre/scale", _vec(sd, "visual.ln_pre.weight"))
+    _set(p, "ln_pre/bias", _vec(sd, "visual.ln_pre.bias"))
+    for i in range(vision_layers):
+        _clip_block(p, sd, f"vision_block_{i}",
+                    f"visual.transformer.resblocks.{i}")
+    _set(p, "ln_post/scale", _vec(sd, "visual.ln_post.weight"))
+    _set(p, "ln_post/bias", _vec(sd, "visual.ln_post.bias"))
+    _set(p, "vision_proj", _vec(sd, "visual.proj"))
+
+    _set(p, "token_embedding/embedding", _vec(sd, "token_embedding.weight"))
+    _set(p, "text_pos", _vec(sd, "positional_embedding"))
+    for i in range(text_layers):
+        _clip_block(p, sd, f"text_block_{i}", f"transformer.resblocks.{i}")
+    _set(p, "ln_final/scale", _vec(sd, "ln_final.weight"))
+    _set(p, "ln_final/bias", _vec(sd, "ln_final.bias"))
+    _set(p, "text_projection", _vec(sd, "text_projection"))
+    _set(p, "logit_scale", np.asarray(sd["logit_scale"]))
+    return p
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -237,11 +316,25 @@ def main(argv=None):
     p_oa.add_argument("--decoder", required=True)
     p_oa.add_argument("--out", required=True)
 
+    p_cl = sub.add_parser("clip")
+    p_cl.add_argument("--ckpt", required=True,
+                      help="torch-saved CLIP ViT model or state_dict")
+    p_cl.add_argument("--out", required=True)
+
     args = parser.parse_args(argv)
     from dalle_pytorch_tpu.utils.checkpoint import save_checkpoint
 
     if args.cmd == "vqgan":
         params = convert_vqgan_state_dict(_torch_load(args.ckpt))
+    elif args.cmd == "clip":
+        sd = _torch_load(args.ckpt)
+        cfg = infer_clip_config(sd)
+        params = {
+            "hparams": cfg,
+            "weights": convert_clip_state_dict(
+                sd, vision_layers=cfg["vision_layers"],
+                text_layers=cfg["text_layers"]),
+        }
     else:
         params = convert_openai_state_dicts(_torch_load(args.encoder),
                                             _torch_load(args.decoder))

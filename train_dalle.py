@@ -299,7 +299,10 @@ def main(argv=None):
 
     from dalle_pytorch_tpu.utils.profiling import StepTimer, dalle_train_flops
 
-    timer = StepTimer(flops_per_step=dalle_train_flops(dalle_cfg, BATCH_SIZE))
+    # BATCH_SIZE is per-host (the loader shards by process); StepTimer's
+    # peak spans every chip of every process, so feed it global-batch FLOPs
+    timer = StepTimer(flops_per_step=dalle_train_flops(
+        dalle_cfg, BATCH_SIZE * jax.process_count()))
     lr = sched.lr
     global_step = 0
     t0 = time.perf_counter()
