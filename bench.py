@@ -77,6 +77,36 @@ def run(use_pallas: bool = False, steps: int = STEPS):
     return batch * steps / dt, dt, cfg, batch
 
 
+def run_generate(batch: int = 8):
+    """AR image-token sampling throughput (BASELINE.md's second north-star:
+    'AR image-tokens/sec (generate)') via the jitted KV-cache sampler."""
+    from dalle_pytorch_tpu import DALLE, DALLEConfig
+    from dalle_pytorch_tpu.models.dalle import generate_codes
+
+    cfg = DALLEConfig(
+        dim=256, num_text_tokens=7800, text_seq_len=80, depth=8, heads=8,
+        dim_head=64, attn_types=("full", "axial_row", "axial_col", "conv_like"),
+        num_image_tokens=8192, image_size=256, image_fmap_size=32,
+        dtype=jnp.bfloat16,
+    )
+    model = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (batch, cfg.text_seq_len), 0,
+                              cfg.num_text_tokens)
+    params = jax.jit(lambda r: model.init(
+        r, text[:1], jnp.zeros((1, cfg.image_seq_len), jnp.int32))["params"])(rng)
+
+    gen = jax.jit(lambda p, t, k: generate_codes(model, {"params": p}, t, k,
+                                                 filter_thres=0.9))
+    codes = gen(params, text, rng)  # compile
+    _ = jax.device_get(codes)
+    t0 = time.perf_counter()
+    codes = gen(params, text, jax.random.PRNGKey(1))
+    _ = jax.device_get(codes)
+    dt = time.perf_counter() - t0
+    return batch * cfg.image_seq_len / dt, dt
+
+
 def main():
     images_per_sec, dt, cfg, batch = run(use_pallas=False)
     # MFU context on stderr; the driver consumes only the stdout JSON line.
@@ -90,6 +120,12 @@ def main():
     flops = dalle_train_flops(cfg, batch) * STEPS / dt
     print(f"achieved {flops/1e12:.2f} TFLOP/s (dense-equivalent), "
           f"MFU {flops/device_peak_flops():.2%}", file=sys.stderr)
+    try:
+        tok_per_sec, _ = run_generate()
+        print(f"generation: {tok_per_sec:.1f} image-tokens/sec "
+              "(KV-cache sampler)", file=sys.stderr)
+    except Exception as e:  # generation bench is informational only
+        print(f"generation bench skipped: {e}", file=sys.stderr)
 
     print(json.dumps({
         "metric": "dalle_cub200_train_throughput",

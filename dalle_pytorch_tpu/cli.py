@@ -18,7 +18,7 @@ import numpy as np
 from . import DALLE, DALLEConfig, DiscreteVAE, VAEConfig
 from .data.tokenizer import ChineseTokenizer, HugTokenizer, SimpleTokenizer
 from .models.dalle import generate_codes
-from .utils.checkpoint import load_checkpoint
+from .utils.checkpoint import load_checkpoint, migrate_qkv_kernels
 
 
 def select_tokenizer(bpe_path: Optional[str], chinese: bool = False):
@@ -66,7 +66,8 @@ def load_dalle_checkpoint(dalle_path: str | Path, taming: bool = False):
 
     cfg = DALLEConfig.from_dict(dalle_params)
     dalle = DALLE(cfg)
-    params = jax.tree.map(jnp.asarray, ckpt['weights'])
+    weights = migrate_qkv_kernels(ckpt['weights'], dim_head=cfg.dim_head)
+    params = jax.tree.map(jnp.asarray, weights)
     return dalle, cfg, params, vae, vae_params
 
 

@@ -234,17 +234,21 @@ class MultiHeadAttention(nn.Module):
     dtype: Any = jnp.float32
 
     def setup(self):
-        inner = self.heads * self.dim_head
-        self.to_qkv = nn.Dense(inner * 3, use_bias=False, dtype=self.dtype, name="to_qkv")
+        # fused QKV as a [dim, 3, heads, dh] DenseGeneral: the (3,) axis is
+        # never sharded, so splitting q/k/v is a free unsharded-axis index,
+        # and tensor parallelism shards the heads axis cleanly (a flat
+        # [dim, 3*inner] kernel sharded on tp makes the q/k/v split a
+        # cross-shard slice that GSPMD can only fully rematerialize)
+        self.to_qkv = nn.DenseGeneral(
+            features=(3, self.heads, self.dim_head), axis=-1, use_bias=False,
+            dtype=self.dtype, name="to_qkv")
         self.to_out = nn.Dense(self.dim, use_bias=True, dtype=self.dtype, name="to_out")
         self.drop = nn.Dropout(self.dropout)
 
     def _qkv(self, x):
-        b, n, _ = x.shape
-        qkv = self.to_qkv(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        split = lambda t: t.reshape(b, n, self.heads, self.dim_head).transpose(0, 2, 1, 3)
-        return split(q), split(k), split(v)
+        qkv = self.to_qkv(x)  # [b, n, 3, heads, dh]
+        qkv = qkv.transpose(2, 0, 3, 1, 4)  # [3, b, heads, n, dh]
+        return qkv[0], qkv[1], qkv[2]
 
     def _key_pad_bias(self, mask, n):
         """[b, m] bool key mask -> additive f32 [b, n] bias, same scoping as

@@ -26,8 +26,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # the '/'-joined param path; first hit wins; default = replicated.
 # Dense kernels are [d_in, d_out]; embeddings are [vocab, dim].
 DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
+    # fused QKV [dim, 3, heads, dh]: fsdp on features, tp on heads
+    (r".*to_qkv/kernel$", P("fsdp", None, "tp", None)),
     # column-parallel projections (split output features over tp)
-    (r".*(to_qkv|to_q|to_k|to_v)/kernel$", P("fsdp", "tp")),
+    (r".*(to_q|to_k|to_v)/kernel$", P("fsdp", "tp")),
     (r".*ff/dense_in/kernel$", P("fsdp", "tp")),
     # row-parallel projections (split input features over tp)
     (r".*to_out/kernel$", P("tp", "fsdp")),

@@ -53,3 +53,30 @@ def load_checkpoint(path: str | Path) -> Any:
 
 def is_process_zero() -> bool:
     return jax.process_index() == 0
+
+
+def migrate_qkv_kernels(tree, dim_head: int = 64):
+    """In-place upgrade of legacy flat fused-QKV kernels.
+
+    Checkpoints written before the DenseGeneral refactor store
+    ``to_qkv/kernel`` as ``[dim, 3*heads*dim_head]``; the current layout is
+    ``[dim, 3, heads, dim_head]`` (bit-compatible reshape).  Heads are
+    inferred from the flat width.  Safe to call on current checkpoints
+    (no-op).  Returns the tree.
+    """
+    if not isinstance(tree, dict):
+        return tree
+    for key, val in tree.items():
+        if key == "to_qkv" and isinstance(val, dict):
+            kern = val.get("kernel")
+            if kern is not None and np.ndim(kern) == 2:
+                kern = np.asarray(kern)
+                width = kern.shape[1]
+                assert width % (3 * dim_head) == 0, (
+                    f"legacy to_qkv kernel width {width} not divisible by "
+                    f"3*dim_head={3 * dim_head}")
+                heads = width // (3 * dim_head)
+                val["kernel"] = kern.reshape(kern.shape[0], 3, heads, dim_head)
+        else:
+            migrate_qkv_kernels(val, dim_head)
+    return tree

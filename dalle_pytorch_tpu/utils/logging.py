@@ -70,6 +70,18 @@ class TrainLogger:
         if self.is_root and self.run is not None:
             self.run.log(payload)
 
+    def save_file(self, path: str):
+        """wandb.save parity (ref train_dalle.py:409, train_vae.py:221)."""
+        if self.is_root and self.run is not None:
+            _wandb.save(path)
+
+    def log_artifact(self, path: str, name: str, type_: str = "model"):
+        """wandb.Artifact upload parity (ref train_vae.py:241-253)."""
+        if self.is_root and self.run is not None:
+            art = _wandb.Artifact(name, type=type_)
+            art.add_file(path)
+            self.run.log_artifact(art)
+
     def finish(self):
         if self._f is not None:
             self._f.close()

@@ -87,6 +87,7 @@ def main(argv=None):
     vtx = make_optimizer(2e-3)
     vopt = jax.jit(vtx.init)(vparams)
     vstep = make_vae_train_step(vae, vtx)
+    vloss = jnp.asarray(float("nan"))
     t0 = time.time()
     for step in range(args.steps_vae):
         _, imgs = make_batch(16)
@@ -111,6 +112,7 @@ def main(argv=None):
     dtx = make_optimizer(1e-3)
     dopt = jax.jit(dtx.init)(dparams)
     dstep = make_dalle_train_step(dalle, dtx, vae=vae)
+    dloss = jnp.asarray(float("nan"))
     t0 = time.time()
     for step in range(args.steps_dalle):
         text, imgs = make_batch(16)

@@ -199,9 +199,12 @@ def get_model_output(dalle_path, out_path, text, num_images, bpe_path,
     reread = read_images(folder, num_images)
 
     if clip_path is not None:
+        from dalle_pytorch_tpu.utils.checkpoint import migrate_qkv_kernels
+
         ckpt = load_checkpoint(clip_path)
         hparams = dict(ckpt['hparams'])
-        clip_params = jax.tree.map(jnp.asarray, ckpt['weights'])
+        clip_params = jax.tree.map(
+            jnp.asarray, migrate_qkv_kernels(ckpt['weights']))
         if 'vision_width' in hparams:
             # converted official OpenAI CLIP ViT (convert_weights.py clip)
             from dalle_pytorch_tpu.models.clip_vit import CLIPViT, CLIPViTConfig

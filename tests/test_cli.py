@@ -245,3 +245,34 @@ def test_genrank_cli(trained_dalle, tiny_tokenizer_json, workdir):
     line = (rank_out / "results.txt").read_text().strip().split(" ")
     assert len(line) == 3  # mname mean std
     assert list(rank_out.glob("B*.npy")) and list(rank_out.glob("B*.png"))
+
+
+def test_legacy_qkv_checkpoint_migration():
+    """Pre-DenseGeneral checkpoints (flat [d, 3*h*dh] to_qkv kernels) load
+    via migrate_qkv_kernels (bit-compatible reshape)."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.utils.checkpoint import migrate_qkv_kernels
+
+    d, h, dh = 8, 2, 4
+    legacy = {
+        "transformer": {
+            "layers_0_attn": {"attn": {"to_qkv": {
+                "kernel": np.arange(d * 3 * h * dh, dtype=np.float32)
+                .reshape(d, 3 * h * dh)}}},
+        },
+        "other": {"kernel": np.ones((d, d), np.float32)},
+    }
+    out = migrate_qkv_kernels(legacy, dim_head=dh)
+    k = out["transformer"]["layers_0_attn"]["attn"]["to_qkv"]["kernel"]
+    assert k.shape == (d, 3, h, dh)
+    # bit-compatible: flattening restores the original layout
+    np.testing.assert_array_equal(
+        k.reshape(d, -1),
+        np.arange(d * 3 * h * dh, dtype=np.float32).reshape(d, 3 * h * dh))
+    # non-qkv kernels untouched
+    assert out["other"]["kernel"].shape == (d, d)
+    # idempotent on current-format checkpoints
+    again = migrate_qkv_kernels(out, dim_head=dh)
+    assert again["transformer"]["layers_0_attn"]["attn"]["to_qkv"][
+        "kernel"].shape == (d, 3, h, dh)

@@ -216,7 +216,12 @@ def main(argv=None):
     dummy_codes = jnp.zeros((1, dalle_cfg.image_seq_len), jnp.int32)
     params = jax.jit(lambda r: dalle.init(r, dummy_text, dummy_codes)['params'])(init_rng)
     if resume_ckpt is not None:
-        params = jax.tree.map(jnp.asarray, resume_ckpt['weights'])
+        from dalle_pytorch_tpu.utils.checkpoint import migrate_qkv_kernels
+
+        params = jax.tree.map(
+            jnp.asarray,
+            migrate_qkv_kernels(resume_ckpt['weights'],
+                                dim_head=dalle_cfg.dim_head))
 
     part = distr_backend.distribute()
     params = part.shard_params(params)
@@ -316,7 +321,7 @@ def main(argv=None):
 
             # average_all syncs on the loss, so the timer sees real step time
             avg_loss = float(distr_backend.average_all(loss))
-            perf = timer.tick(BATCH_SIZE)
+            perf = timer.tick(BATCH_SIZE * jax.process_count())
             epoch_losses.append(avg_loss)
             logger.step(epoch, i, avg_loss, lr, extra=perf)
 
@@ -333,6 +338,7 @@ def main(argv=None):
                     decoded = tokenizer.decode(np.asarray(text[0]))
                     logger.log({'image_caption': decoded})
                 save_model('./dalle.pt', epoch)
+                logger.save_file('./dalle.pt')  # wandb.save parity (ref :409)
             global_step += 1
 
         # per-epoch plateau step on the epoch-mean loss (ref :415-416)
@@ -347,6 +353,9 @@ def main(argv=None):
                   f'({dt:.1f}s elapsed)')
 
     save_model('./dalle-final.pt', EPOCHS)
+    if distr_backend.is_root_worker():
+        # wandb artifact upload parity (ref train_dalle.py:430-437)
+        logger.log_artifact('./dalle-final.pt', 'trained-dalle')
     logger.finish()
 
 

@@ -192,6 +192,7 @@ def main(argv=None):
                     save_checkpoint('vae.pt', {
                         'hparams': cfg.to_dict(), 'weights': weights,
                     })
+                    logger.save_file('vae.pt')  # wandb.save parity (ref :221)
 
                 # temperature anneal + lr decay, per-epoch `i % 100` cadence
                 # exactly as the reference (ref :211-217 — it also fires at
@@ -212,6 +213,8 @@ def main(argv=None):
         save_checkpoint('vae-final.pt', {
             'hparams': cfg.to_dict(), 'weights': weights,
         })
+        # wandb artifact upload parity (ref train_vae.py:241-253)
+        logger.log_artifact('vae-final.pt', 'trained-vae')
     logger.finish()
 
 
