@@ -29,16 +29,24 @@ import jax.numpy as jnp
 STEPS = 50
 
 
-def run(use_pallas: bool = False, steps: int = STEPS):
-    from dalle_pytorch_tpu import DALLE, DALLEConfig
-    from dalle_pytorch_tpu.training import make_dalle_train_step, make_optimizer
+def cub200_config(use_pallas: bool = False):
+    """The CUB-200 benchmark model (ref train_dalle.py:74-97), shared by the
+    train and generate stages."""
+    from dalle_pytorch_tpu import DALLEConfig
 
-    cfg = DALLEConfig(
+    return DALLEConfig(
         dim=256, num_text_tokens=7800, text_seq_len=80, depth=8, heads=8,
         dim_head=64, attn_types=("full", "axial_row", "axial_col", "conv_like"),
         num_image_tokens=8192, image_size=256, image_fmap_size=32,
         use_pallas=use_pallas, dtype=jnp.bfloat16,
     )
+
+
+def run(use_pallas: bool = False, steps: int = STEPS):
+    from dalle_pytorch_tpu import DALLE
+    from dalle_pytorch_tpu.training import make_dalle_train_step, make_optimizer
+
+    cfg = cub200_config(use_pallas=use_pallas)
     model = DALLE(cfg)
     batch = 16
 
@@ -80,15 +88,10 @@ def run(use_pallas: bool = False, steps: int = STEPS):
 def run_generate(batch: int = 8):
     """AR image-token sampling throughput (BASELINE.md's second north-star:
     'AR image-tokens/sec (generate)') via the jitted KV-cache sampler."""
-    from dalle_pytorch_tpu import DALLE, DALLEConfig
+    from dalle_pytorch_tpu import DALLE
     from dalle_pytorch_tpu.models.dalle import generate_codes
 
-    cfg = DALLEConfig(
-        dim=256, num_text_tokens=7800, text_seq_len=80, depth=8, heads=8,
-        dim_head=64, attn_types=("full", "axial_row", "axial_col", "conv_like"),
-        num_image_tokens=8192, image_size=256, image_fmap_size=32,
-        dtype=jnp.bfloat16,
-    )
+    cfg = cub200_config()
     model = DALLE(cfg)
     rng = jax.random.PRNGKey(0)
     text = jax.random.randint(rng, (batch, cfg.text_seq_len), 0,

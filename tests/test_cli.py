@@ -247,6 +247,75 @@ def test_genrank_cli(trained_dalle, tiny_tokenizer_json, workdir):
     assert list(rank_out.glob("B*.npy")) and list(rank_out.glob("B*.png"))
 
 
+def test_legacy_ckpt_resume_with_flat_opt_state(trained_dalle, tiny_dataset,
+                                                tiny_tokenizer_json, workdir,
+                                                tmp_path):
+    """Resume from a pre-DenseGeneral checkpoint: both the params AND the
+    saved adam moments carry flat [d, 3*h*dh] to_qkv kernels; resume must
+    reshape both to the current [d, 3, h, dh] layout and train."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.utils.checkpoint import (load_checkpoint,
+                                                    save_checkpoint)
+
+    def flatten_qkv(tree):
+        if isinstance(tree, list):
+            # opt_state is saved as a flat LIST of leaves (train_dalle
+            # save_model); qkv-shaped moments are the [d, 3, h, dh] arrays
+            for i, val in enumerate(tree):
+                if np.ndim(val) == 4 and np.shape(val)[1] == 3:
+                    v = np.asarray(val)
+                    tree[i] = v.reshape(v.shape[0], -1)
+            return
+        if not isinstance(tree, dict):
+            return
+        for key, val in tree.items():
+            if key == "to_qkv" and isinstance(val, dict) and \
+                    np.ndim(val.get("kernel")) == 4:
+                k = np.asarray(val["kernel"])
+                val["kernel"] = k.reshape(k.shape[0], -1)
+            else:
+                flatten_qkv(val)
+
+    ckpt = load_checkpoint(trained_dalle)
+    flatten_qkv(ckpt["weights"])
+    flatten_qkv(ckpt["opt_state"])
+    assert any(np.ndim(v) == 2 for v in ckpt["opt_state"]
+               if hasattr(v, "shape")), "no adam moments were flattened"
+    legacy_path = tmp_path / "legacy.pt"
+    save_checkpoint(legacy_path, ckpt)
+
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps({"BATCH_SIZE": 4})
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        import train_dalle
+
+        train_dalle.main(["--dalle_path", str(legacy_path),
+                          "--image_text_folder", str(tiny_dataset),
+                          "--bpe_path", str(tiny_tokenizer_json),
+                          "--truncate_captions",
+                          "--epochs", str(int(ckpt["epoch"]) + 1)])
+    finally:
+        os.chdir(cwd)
+        del os.environ["DALLE_TPU_HPARAMS"]
+    out = load_checkpoint(tmp_path / "dalle-final.pt")
+    k = None
+
+    def find_qkv(tree):
+        nonlocal k
+        if not isinstance(tree, dict):
+            return
+        for key, val in tree.items():
+            if key == "to_qkv" and isinstance(val, dict):
+                k = np.asarray(val["kernel"])
+            else:
+                find_qkv(val)
+
+    find_qkv(out["weights"])
+    assert k is not None and k.ndim == 4  # re-saved in the current layout
+
+
 def test_legacy_qkv_checkpoint_migration():
     """Pre-DenseGeneral checkpoints (flat [d, 3*h*dh] to_qkv kernels) load
     via migrate_qkv_kernels (bit-compatible reshape)."""

@@ -243,8 +243,18 @@ def main(argv=None):
     tx = make_optimizer(LEARNING_RATE, grad_clip_norm=GRAD_CLIP_NORM)
     opt_state = jax.jit(tx.init)(params)
     if resume_ckpt is not None and 'opt_state' in resume_ckpt:
+        def _fit_leaf(tmpl, v):
+            if not hasattr(tmpl, 'dtype'):
+                return v
+            v = jnp.asarray(v)
+            if v.shape != tmpl.shape and v.size == tmpl.size:
+                # legacy flat fused-QKV adam moments -> DenseGeneral layout
+                # (same migration migrate_qkv_kernels applies to the params)
+                v = v.reshape(tmpl.shape)
+            return v.astype(tmpl.dtype)
+
         opt_state = jax.tree.map(
-            lambda tmpl, v: jnp.asarray(v).astype(tmpl.dtype) if hasattr(tmpl, 'dtype') else v,
+            _fit_leaf,
             opt_state, jax.tree.unflatten(jax.tree.structure(opt_state),
                                           jax.tree.leaves(resume_ckpt['opt_state'])))
 
