@@ -231,6 +231,7 @@ class MultiHeadAttention(nn.Module):
     dropout: float = 0.0
     use_pallas: bool = False
     ring_axis: Optional[str] = None  # sequence-parallel axis (inside shard_map)
+    sp_impl: str = "ring"            # 'ring' (k/v rotation) | 'ulysses' (all-to-all)
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -266,15 +267,20 @@ class MultiHeadAttention(nn.Module):
         if self.ring_axis is not None:
             # sequence parallelism: x is this device's sequence shard and we
             # are inside a shard_map over `ring_axis` — exact attention via
-            # the k/v ring rotation (parallel/ring.py)
-            from ..parallel.ring import ring_attention
-
+            # k/v ring rotation (parallel/ring.py) or head<->sequence
+            # all-to-all (parallel/ulysses.py)
             assert mask is None, (
-                "ring attention does not take a key padding mask; fold it "
-                "into the token stream instead")
-            out = ring_attention(q, k, v, axis_name=self.ring_axis,
-                                 pattern=self.pattern,
-                                 causal=self.pattern.causal)
+                "sequence-parallel attention does not take a key padding "
+                "mask; fold it into the token stream instead")
+            assert self.sp_impl in ("ring", "ulysses"), (
+                f"unknown sp_impl {self.sp_impl!r}")
+            if self.sp_impl == "ulysses":
+                from ..parallel.ulysses import ulysses_attention as sp_attn
+            else:
+                from ..parallel.ring import ring_attention as sp_attn
+            out = sp_attn(q, k, v, axis_name=self.ring_axis,
+                          pattern=self.pattern,
+                          causal=self.pattern.causal)
         elif self.use_pallas:
             from .attention_pallas import flash_pattern_attention
 

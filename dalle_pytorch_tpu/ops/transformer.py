@@ -48,6 +48,7 @@ class AttnBlock(nn.Module):
     dropout: float = 0.0
     use_pallas: bool = False
     ring_axis: Optional[str] = None
+    sp_impl: str = "ring"
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -56,7 +57,7 @@ class AttnBlock(nn.Module):
             pattern=self.pattern, dim=self.dim, heads=self.heads,
             dim_head=self.dim_head, dropout=self.dropout,
             use_pallas=self.use_pallas, ring_axis=self.ring_axis,
-            dtype=self.dtype,
+            sp_impl=self.sp_impl, dtype=self.dtype,
             name="attn",
         )
         self.scale = self.param(
@@ -132,6 +133,7 @@ class Transformer(nn.Module):
     use_remat: bool = False
     use_pallas: bool = False   # Pallas flash/block-sparse attention kernels
     ring_axis: Optional[str] = None  # sequence-parallel axis (inside shard_map)
+    sp_impl: str = "ring"            # 'ring' | 'ulysses' (all-to-all)
     sparse_layout_seed: int = 0
     dtype: Any = jnp.float32
 
@@ -155,7 +157,8 @@ class Transformer(nn.Module):
                 pattern=pattern, dim=self.dim, layer_index=ind + 1,
                 heads=self.heads, dim_head=self.dim_head,
                 dropout=self.attn_dropout, use_pallas=self.use_pallas,
-                ring_axis=self.ring_axis, dtype=self.dtype,
+                ring_axis=self.ring_axis, sp_impl=self.sp_impl,
+                dtype=self.dtype,
                 name=f"layers_{ind}_attn",
             ))
             ff_blocks.append(FFBlock(
