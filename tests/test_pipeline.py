@@ -1,0 +1,131 @@
+"""Pipeline parallelism (GPipe schedule over a 'pp' mesh axis) vs the
+unsharded Transformer, on 8 virtual CPU devices."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from dalle_pytorch_tpu.ops.transformer import Transformer
+from dalle_pytorch_tpu.parallel.pipeline import (pipeline_transformer,
+                                                 stack_stage_params)
+
+TEXT, FMAP = 8, 4
+N = TEXT + FMAP * FMAP
+DIM, DEPTH, HEADS, DH = 32, 4, 2, 16
+
+
+def make_tf(depth=DEPTH, attn_types=("full", "axial_row")):
+    return Transformer(dim=DIM, depth=depth, seq_len=N - 1, causal=True,
+                       heads=HEADS, dim_head=DH, attn_types=attn_types,
+                       image_fmap_size=FMAP, text_len=TEXT)
+
+
+@pytest.fixture(scope="module")
+def mesh_pp2():
+    devices = np.asarray(jax.devices()[:2]).reshape(2)
+    return Mesh(devices, ("pp",))
+
+
+@pytest.fixture(scope="module")
+def mesh_pp4():
+    devices = np.asarray(jax.devices()[:4]).reshape(4)
+    return Mesh(devices, ("pp",))
+
+
+@pytest.fixture(scope="module")
+def mesh_dp2pp2():
+    devices = np.asarray(jax.devices()[:4]).reshape(2, 2)
+    return Mesh(devices, ("dp", "pp"))
+
+
+def setup(key, batch=4):
+    tf = make_tf()
+    x = jax.random.normal(key, (batch, N, DIM))
+    params = tf.init(jax.random.PRNGKey(7), x)["params"]
+    return tf, params, x
+
+
+def test_stack_stage_params_roundtrip():
+    tf, params, x = setup(jax.random.PRNGKey(0))
+    stacked = stack_stage_params(params, DEPTH, 2)
+    # stage 0 of layers_0_attn == original layers_0_attn; stage 1 == layers_2
+    k0 = jax.tree.leaves(jax.tree.map(lambda p: p[0], stacked["layers_0_attn"]))
+    ref0 = jax.tree.leaves(params["layers_0_attn"])
+    for a, b in zip(k0, ref0):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    k1 = jax.tree.leaves(jax.tree.map(lambda p: p[1], stacked["layers_0_attn"]))
+    ref1 = jax.tree.leaves(params["layers_2_attn"])
+    for a, b in zip(k1, ref1):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("num_microbatches", [2, 4])
+def test_pipeline_matches_local_pp2(mesh_pp2, num_microbatches):
+    tf, params, x = setup(jax.random.PRNGKey(1))
+    ref = tf.apply({"params": params}, x)
+    _, stacked, apply_fn = pipeline_transformer(
+        tf, params, mesh=mesh_pp2, num_microbatches=num_microbatches)
+    with mesh_pp2:
+        out = apply_fn(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_matches_local_pp4(mesh_pp4):
+    tf = make_tf(depth=4, attn_types=("full",))
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, N, DIM))
+    params = tf.init(jax.random.PRNGKey(8), x)["params"]
+    ref = tf.apply({"params": params}, x)
+    _, stacked, apply_fn = pipeline_transformer(
+        tf, params, mesh=mesh_pp4, num_microbatches=4)
+    with mesh_pp4:
+        out = apply_fn(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_dp_times_pp(mesh_dp2pp2):
+    tf, params, x = setup(jax.random.PRNGKey(3))
+    ref = tf.apply({"params": params}, x)
+    _, stacked, apply_fn = pipeline_transformer(
+        tf, params, mesh=mesh_dp2pp2, num_microbatches=2, dp_axis="dp")
+    with mesh_dp2pp2:
+        out = apply_fn(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_gradients(mesh_pp2):
+    tf, params, x = setup(jax.random.PRNGKey(4))
+    _, stacked, apply_fn = pipeline_transformer(
+        tf, params, mesh=mesh_pp2, num_microbatches=2)
+    tangent = jax.random.normal(jax.random.PRNGKey(5), x.shape)
+
+    def loss_pipe(sp):
+        return jnp.sum(apply_fn(sp, x) * tangent)
+
+    def loss_local(p):
+        return jnp.sum(tf.apply({"params": p}, x) * tangent)
+
+    with mesh_pp2:
+        g_pipe = jax.grad(loss_pipe)(stacked)
+    g_ref = jax.grad(loss_local)(params)
+    g_ref_stacked = stack_stage_params(g_ref, DEPTH, 2)
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_ref_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_rejects_bad_cuts():
+    tf, params, x = setup(jax.random.PRNGKey(6))
+    devices = np.asarray(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devices, ("pp",))
+    bad = make_tf(depth=4, attn_types=("full", "axial_row", "axial_col",
+                                       "conv_like"))
+    bad_params = bad.init(jax.random.PRNGKey(9), x)["params"]
+    with pytest.raises(AssertionError):
+        pipeline_transformer(bad, bad_params, mesh=mesh,
+                             num_microbatches=2)  # stage depth 2 < cycle 4
