@@ -112,6 +112,42 @@ class FFBlock(nn.Module):
         return h * self.scale.astype(h.dtype)
 
 
+class MoEFFBlock(nn.Module):
+    """LayerScale(PreNorm(MoE feed-forward)) — the FFBlock with its GEGLU
+    swapped for a top-k routed expert mixture (ops/moe.py).  The switch
+    load-balance loss is sown into the ``losses`` collection as
+    ``moe_aux``; training loops read it via ``mutable=['losses']``."""
+
+    dim: int
+    layer_index: int
+    num_experts: int = 8
+    top_k: int = 2
+    mult: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        from .moe import MoEFeedForward
+
+        self.norm = nn.LayerNorm(dtype=jnp.float32, name="norm")
+        self.moe = MoEFeedForward(
+            dim=self.dim, num_experts=self.num_experts, top_k=self.top_k,
+            mult=self.mult, dtype=self.dtype, name="moe")
+        self.drop = nn.Dropout(self.dropout)
+        self.scale = self.param(
+            "scale",
+            lambda key, shape: jnp.full(shape, layerscale_init(self.layer_index)),
+            (1, 1, self.dim),
+        )
+
+    def __call__(self, x, deterministic: bool = True):
+        h, aux = self.moe(self.norm(x).astype(x.dtype),
+                          deterministic=deterministic)
+        self.sow("losses", "moe_aux", aux)
+        h = self.drop(h, deterministic=deterministic)
+        return h * self.scale.astype(h.dtype)
+
+
 class Transformer(nn.Module):
     """Depth x (attn, ff) residual stack with cycled attention variants
     (ref transformer.py:71-123)."""
@@ -134,6 +170,8 @@ class Transformer(nn.Module):
     use_pallas: bool = False   # Pallas flash/block-sparse attention kernels
     ring_axis: Optional[str] = None  # sequence-parallel axis (inside shard_map)
     sp_impl: str = "ring"            # 'ring' | 'ulysses' (all-to-all)
+    ff_experts: int = 0        # >1: MoE feed-forward with this many experts
+    ff_expert_top_k: int = 2
     sparse_layout_seed: int = 0
     dtype: Any = jnp.float32
 
@@ -161,36 +199,53 @@ class Transformer(nn.Module):
                 dtype=self.dtype,
                 name=f"layers_{ind}_attn",
             ))
-            ff_blocks.append(FFBlock(
-                dim=self.dim, layer_index=ind + 1, mult=self.ff_mult,
-                dropout=self.ff_dropout, dtype=self.dtype,
-                name=f"layers_{ind}_ff",
-            ))
+            if self.ff_experts > 1:
+                ff_blocks.append(MoEFFBlock(
+                    dim=self.dim, layer_index=ind + 1,
+                    num_experts=self.ff_experts, top_k=self.ff_expert_top_k,
+                    mult=self.ff_mult, dropout=self.ff_dropout,
+                    dtype=self.dtype, name=f"layers_{ind}_ff",
+                ))
+            else:
+                ff_blocks.append(FFBlock(
+                    dim=self.dim, layer_index=ind + 1, mult=self.ff_mult,
+                    dropout=self.ff_dropout, dtype=self.dtype,
+                    name=f"layers_{ind}_ff",
+                ))
         self.attn_blocks = attn_blocks
         self.ff_blocks = ff_blocks
+
+    def _block_apply(self, x, ind: int, mask, deterministic: bool):
+        """One (attn, ff) residual block — a method so lifted transforms
+        (nn.remat) can thread params AND mutable collections (MoE's sown
+        aux losses) through it; a raw jax.checkpoint closure would leak
+        tracers out of any sown value."""
+        x = x + self.attn_blocks[ind](x, mask=mask, deterministic=deterministic)
+        x = x + self.ff_blocks[ind](x, deterministic=deterministic)
+        return x
 
     def __call__(self, x, mask=None, deterministic: bool = True,
                  return_kv: bool = False):
         if self.reversible and not self.is_initializing():
             return self._reversible_call(x, mask, deterministic, return_kv)
 
-        kvs = []
-        for attn, ff in zip(self.attn_blocks, self.ff_blocks):
-            def block(x, attn=attn, ff=ff):
-                if return_kv:
-                    h, kv = attn(x, mask=mask, deterministic=deterministic,
-                                 return_kv=True)
-                    kvs.append(kv)
-                else:
-                    h = attn(x, mask=mask, deterministic=deterministic)
-                x = x + h
-                x = x + ff(x, deterministic=deterministic)
-                return x
+        use_remat = (self.use_remat and not self.is_initializing()
+                     and not return_kv)
+        remat_block = nn.remat(
+            Transformer._block_apply, static_argnums=(2, 4)) if use_remat else None
 
-            if self.use_remat and not self.is_initializing() and not return_kv:
-                x = jax.checkpoint(block)(x)
+        kvs = []
+        for ind in range(self.depth):
+            if return_kv:
+                h, kv = self.attn_blocks[ind](
+                    x, mask=mask, deterministic=deterministic, return_kv=True)
+                kvs.append(kv)
+                x = x + h
+                x = x + self.ff_blocks[ind](x, deterministic=deterministic)
+            elif use_remat:
+                x = remat_block(self, x, ind, mask, deterministic)
             else:
-                x = block(x)
+                x = self._block_apply(x, ind, mask, deterministic)
         if return_kv:
             return x, kvs
         return x
@@ -225,6 +280,10 @@ class Transformer(nn.Module):
         assert deterministic or (self.attn_dropout == 0 and self.ff_dropout == 0), (
             "the reversible executor requires deterministic blocks (no dropout); "
             "the reference replays RNG state instead (reversible.py:20-50)"
+        )
+        assert self.ff_experts <= 1, (
+            "the reversible executor's custom_vjp cannot thread the MoE "
+            "load-balance aux losses; sowing would silently no-op"
         )
         if return_kv:
             # prefill path (no grads): run the two-stream loop inline so each

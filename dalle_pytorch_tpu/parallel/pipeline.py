@@ -158,6 +158,9 @@ def pipeline_transformer(tf, params: dict, *, mesh: Mesh,
     assert tf.attn_dropout == 0 and tf.ff_dropout == 0, (
         "pipeline stages run deterministically; dropout would be silently "
         "disabled")
+    assert tf.ff_experts <= 1, (
+        "pipeline stages apply without mutable collections, so the MoE "
+        "load-balance aux losses would silently vanish")
 
     # clone so every other field (dtype, use_pallas, remat, ...) carries over
     stage = tf.clone(depth=per, name=None)

@@ -1,0 +1,152 @@
+"""MoE feed-forward (ops/moe.py) + expert parallelism over an 'ep' axis."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dalle_pytorch_tpu.ops.moe import MoEFeedForward, ep_shard_moe_params
+
+B, N, DIM = 2, 6, 16
+
+
+def test_single_expert_is_plain_geglu():
+    """With num_experts=1 the router gate is exactly 1.0, so the module
+    reduces to one GEGLU FF computed from its own kernels."""
+    moe = MoEFeedForward(dim=DIM, num_experts=1, top_k=1, mult=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, N, DIM))
+    params = moe.init(jax.random.PRNGKey(1), x)["params"]
+    y, aux = moe.apply({"params": params}, x)
+
+    w_in, b_in = params["w_in"][0], params["b_in"][0]
+    w_out, b_out = params["w_out"][0], params["b_out"][0]
+    h = x @ w_in + b_in
+    h, gates = jnp.split(h, 2, axis=-1)
+    ref = (h * jax.nn.gelu(gates)) @ w_out + b_out
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    assert np.isclose(float(aux), 1.0)  # e * (1 * 1) with one expert
+
+
+def test_identical_experts_make_routing_invisible():
+    """Combine weights renormalize to 1 over the selected experts, so if
+    all experts share kernels the output equals any single expert's."""
+    moe = MoEFeedForward(dim=DIM, num_experts=4, top_k=2, mult=2)
+    x = jax.random.normal(jax.random.PRNGKey(2), (B, N, DIM))
+    params = dict(moe.init(jax.random.PRNGKey(3), x)["params"])
+    for name in ("w_in", "b_in", "w_out", "b_out"):
+        tiled = jnp.broadcast_to(params[name][:1], params[name].shape)
+        params[name] = tiled
+    y, _ = moe.apply({"params": params}, x)
+
+    single = MoEFeedForward(dim=DIM, num_experts=1, top_k=1, mult=2)
+    sp = {k: v[:1] for k, v in params.items() if k != "router"}
+    sp["router"] = {"kernel": jnp.zeros((DIM, 1)), "bias": jnp.zeros((1,))}
+    ref, _ = single.apply({"params": sp}, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grads_flow_and_aux_finite():
+    moe = MoEFeedForward(dim=DIM, num_experts=4, top_k=2, mult=2)
+    x = jax.random.normal(jax.random.PRNGKey(4), (B, N, DIM))
+    params = moe.init(jax.random.PRNGKey(5), x)["params"]
+
+    def loss(p):
+        y, aux = moe.apply({"params": p}, x)
+        return jnp.mean(y ** 2) + 0.01 * aux
+
+    val, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(val))
+    for leaf in jax.tree.leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    # router must receive gradient (through the combine weights)
+    assert float(jnp.abs(grads["router"]["kernel"]).sum()) > 0
+
+
+def test_ep_sharded_matches_unsharded():
+    devices = np.asarray(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("ep",))
+    moe = MoEFeedForward(dim=DIM, num_experts=4, top_k=2, mult=2)
+    x = jax.random.normal(jax.random.PRNGKey(6), (B, N, DIM))
+    params = moe.init(jax.random.PRNGKey(7), x)["params"]
+    ref, ref_aux = moe.apply({"params": params}, x)
+
+    shardings = ep_shard_moe_params(params, mesh, "ep")
+    sharded_params = jax.device_put(params, shardings)
+    # expert-stacked leaves sharded on ep, router replicated
+    assert sharded_params["w_in"].sharding.spec == P("ep")
+    assert sharded_params["router"]["kernel"].sharding.spec == P()
+
+    with mesh:
+        y, aux = jax.jit(lambda p, x: moe.apply({"params": p}, x))(
+            sharded_params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-5)
+
+
+def test_transformer_moe_ff_with_remat():
+    """MoE aux losses must come out concrete under per-block remat (lifted
+    nn.remat; a raw jax.checkpoint closure leaks tracers from sow)."""
+    from dalle_pytorch_tpu.ops.transformer import Transformer
+
+    tf = Transformer(dim=DIM, depth=2, seq_len=N - 1, causal=True, heads=2,
+                     dim_head=8, attn_types=("full",), ff_experts=4,
+                     ff_expert_top_k=2, use_remat=True)
+    x = jax.random.normal(jax.random.PRNGKey(10), (B, N, DIM))
+    params = tf.init(jax.random.PRNGKey(11), x)["params"]
+
+    def loss(p):
+        out, state = tf.apply({"params": p}, x, mutable=["losses"])
+        return jnp.mean(out ** 2) + 0.01 * sum(jax.tree.leaves(state["losses"]))
+
+    val, grads = jax.jit(jax.value_and_grad(loss))(params)
+    assert np.isfinite(float(val))
+    assert float(jnp.abs(
+        grads["layers_0_ff"]["moe"]["router"]["kernel"]).sum()) > 0
+
+
+def test_moe_rejected_by_whole_depth_executors():
+    """Reversible and pipeline executors cannot thread sown aux losses and
+    must reject MoE loudly."""
+    from jax.sharding import Mesh
+
+    from dalle_pytorch_tpu.ops.transformer import Transformer
+    from dalle_pytorch_tpu.parallel.pipeline import pipeline_transformer
+
+    x = jax.random.normal(jax.random.PRNGKey(12), (B, N, DIM))
+    rev = Transformer(dim=DIM, depth=2, seq_len=N - 1, causal=True, heads=2,
+                      dim_head=8, attn_types=("full",), ff_experts=4,
+                      reversible=True)
+    params = rev.init(jax.random.PRNGKey(13), x)["params"]
+    with pytest.raises(AssertionError):
+        rev.apply({"params": params}, x)
+
+    pipe = Transformer(dim=DIM, depth=2, seq_len=N - 1, causal=True, heads=2,
+                       dim_head=8, attn_types=("full",), ff_experts=4)
+    pparams = pipe.init(jax.random.PRNGKey(14), x)["params"]
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("pp",))
+    with pytest.raises(AssertionError):
+        pipeline_transformer(pipe, pparams, mesh=mesh, num_microbatches=2)
+
+
+def test_transformer_moe_ff():
+    """Transformer(ff_experts=4) runs, sows per-layer aux losses, and its
+    param tree carries expert-stacked FF kernels."""
+    from dalle_pytorch_tpu.ops.transformer import Transformer
+
+    tf = Transformer(dim=DIM, depth=2, seq_len=N - 1, causal=True, heads=2,
+                     dim_head=8, attn_types=("full",), ff_experts=4,
+                     ff_expert_top_k=2)
+    x = jax.random.normal(jax.random.PRNGKey(8), (B, N, DIM))
+    variables = tf.init(jax.random.PRNGKey(9), x)
+    out, state = tf.apply({"params": variables["params"]}, x,
+                          mutable=["losses"])
+    assert out.shape == x.shape
+    aux = jax.tree.leaves(state["losses"])
+    assert len(aux) == 2  # one sown aux per MoE layer
+    assert all(np.isfinite(float(a)) for a in aux)
+    assert variables["params"]["layers_0_ff"]["moe"]["w_in"].shape[0] == 4
