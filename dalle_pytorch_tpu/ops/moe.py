@@ -3,25 +3,28 @@
 The reference has no MoE (its FF is a single GEGLU block,
 `/root/reference/dalle_pytorch/transformer.py:53-69`); this is scaling
 headroom alongside the framework's other mesh axes (dp/fsdp/tp in mesh.py,
-sp in ring.py/ulysses.py, pp in pipeline.py): widen the FF capacity by
-``num_experts`` while keeping per-token FLOPs constant via top-k routing.
+sp in ring.py/ulysses.py, pp in pipeline.py): widen FF *capacity* (params)
+by ``num_experts`` while the top-k router keeps each token's output a
+mixture of k experts.
 
 TPU-native design choices:
 * **dense one-hot dispatch** — combine weights are a [tokens, experts]
   matrix multiplied through stacked expert kernels with einsum.  No
   scatter/gather, no dynamic shapes: everything is MXU matmuls that GSPMD
-  shards cleanly.  (Capacity-factor dropping, the usual TPU trick for
-  sparse dispatch, is a later optimization; at parity scale the dense form
-  is both simpler and faster to compile.)
+  shards cleanly.  NOTE: dense dispatch computes every expert for every
+  token, so FF *FLOPs* scale with ``num_experts`` (the savings are in
+  params-per-token statistics, not compute); capacity-factor token
+  dropping — the TPU trick that makes FLOPs scale with ``top_k`` — is the
+  designated later optimization.
 * **expert parallelism by sharding annotation** — expert-stacked kernels
   carry a leading ``num_experts`` axis; `Partitioner`-style regex rules or
   an explicit `with_sharding_constraint` put that axis on an ``ep`` mesh
   axis and XLA inserts the all-to-alls.  The module itself stays a pure
   function — same philosophy as the rest of the framework (the reference's
   NCCL machinery became shardings, SURVEY.md §2.3).
-* **router in f32** with jitter noise under a dedicated RNG, switch-style
-  load-balance auxiliary loss (mean fraction x mean probability per
-  expert), returned separately so callers weight it.
+* **router in f32**, switch-style load-balance auxiliary loss (mean
+  fraction x mean probability per expert), returned separately so callers
+  weight it.
 """
 from __future__ import annotations
 
@@ -44,7 +47,7 @@ class MoEFeedForward(nn.Module):
     num_experts: int = 8
     top_k: int = 2
     mult: int = 4
-    router_jitter: float = 0.0
+    dropout: float = 0.0   # on the expert inner activations (FFBlock parity)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -57,11 +60,6 @@ class MoEFeedForward(nn.Module):
         # --- router (f32 for a stable softmax) ---
         router = nn.Dense(e, dtype=jnp.float32, name="router")
         logits = router(x.astype(jnp.float32))  # [b, n, e]
-        if self.router_jitter > 0 and not deterministic:
-            key = self.make_rng("router")
-            logits = logits * jax.random.uniform(
-                key, logits.shape, minval=1.0 - self.router_jitter,
-                maxval=1.0 + self.router_jitter)
         probs = jax.nn.softmax(logits, axis=-1)
 
         # top-k combine weights, renormalized over the selected experts
@@ -94,6 +92,9 @@ class MoEFeedForward(nn.Module):
         h = jnp.einsum("bnd,edi->bnei", xc, w_in) + b_in
         h, gates = jnp.split(h, 2, axis=-1)
         h = h * nn.gelu(gates)
+        # dropout on the inner activation, matching FFBlock's placement
+        # (between the GEGLU gate and the output projection)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         y = jnp.einsum("bnei,eid->bned", h, w_out) + b_out  # [b, n, e, d]
         y = jnp.einsum("bned,bne->bnd", y, combine.astype(self.dtype))
         return y.astype(x.dtype), aux.astype(jnp.float32)

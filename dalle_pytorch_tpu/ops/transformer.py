@@ -132,8 +132,8 @@ class MoEFFBlock(nn.Module):
         self.norm = nn.LayerNorm(dtype=jnp.float32, name="norm")
         self.moe = MoEFeedForward(
             dim=self.dim, num_experts=self.num_experts, top_k=self.top_k,
-            mult=self.mult, dtype=self.dtype, name="moe")
-        self.drop = nn.Dropout(self.dropout)
+            mult=self.mult, dropout=self.dropout, dtype=self.dtype,
+            name="moe")
         self.scale = self.param(
             "scale",
             lambda key, shape: jnp.full(shape, layerscale_init(self.layer_index)),
@@ -144,7 +144,6 @@ class MoEFFBlock(nn.Module):
         h, aux = self.moe(self.norm(x).astype(x.dtype),
                           deterministic=deterministic)
         self.sow("losses", "moe_aux", aux)
-        h = self.drop(h, deterministic=deterministic)
         return h * self.scale.astype(h.dtype)
 
 
