@@ -44,7 +44,7 @@ def _load() -> Optional[ctypes.CDLL]:
         def probe():
             try:
                 lib = ctypes.CDLL(str(_LIB_PATH))
-                return lib if lib.dalle_host_ops_version() == 2 else None
+                return lib if lib.dalle_host_ops_version() == 3 else None
             except (OSError, AttributeError):
                 return None
 
@@ -53,7 +53,10 @@ def _load() -> Optional[ctypes.CDLL]:
             # missing or stale .so: delete first — make would consider a
             # newer-mtime stale binary up to date, and dlopen caches the old
             # inode, so an in-place rebuild could never be picked up
-            _LIB_PATH.unlink(missing_ok=True)
+            try:
+                _LIB_PATH.unlink(missing_ok=True)
+            except OSError:  # read-only install: degrade to pure Python
+                return None
             if not build():
                 return None
             lib = probe()
@@ -70,6 +73,17 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.POINTER(ctypes.c_float)), ctypes.c_int,
             ctypes.c_int64, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
         ]
+        lib.bpe_create.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.bpe_create.restype = ctypes.c_void_p
+        lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+        lib.bpe_encode_word.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ]
+        lib.bpe_encode_word.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -100,6 +114,53 @@ def crop_resize_normalize(img_u8: np.ndarray, top: float, left: float,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         out_size, out_size, nthreads)
     return out
+
+
+class BpeEngine:
+    """Native byte-level BPE merge loop in vocab-id space.
+
+    Construct with the merge rules as id triples (first, second, merged) in
+    rank order; `encode_word` maps a word's symbol ids to its merged BPE
+    ids with exact parity to SimpleTokenizer's Python loop.  Use
+    `BpeEngine.create` which returns None when the library is unavailable.
+    """
+
+    def __init__(self, lib, handle):
+        self._lib = lib
+        self._handle = handle
+
+    @classmethod
+    def create(cls, pairs_a, pairs_b, merged) -> Optional["BpeEngine"]:
+        lib = _load()
+        if lib is None:
+            return None
+        a = np.ascontiguousarray(pairs_a, dtype=np.int32)
+        b = np.ascontiguousarray(pairs_b, dtype=np.int32)
+        c = np.ascontiguousarray(merged, dtype=np.int32)
+        assert a.shape == b.shape == c.shape and a.ndim == 1
+        handle = lib.bpe_create(
+            len(a), a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            b.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            c.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if not handle:
+            return None
+        return cls(lib, handle)
+
+    def encode_word(self, symbol_ids) -> list:
+        ids = np.ascontiguousarray(symbol_ids, dtype=np.int32)
+        out = np.empty(len(ids), np.int32)
+        n = self._lib.bpe_encode_word(
+            self._handle, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(ids), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(out))
+        assert n >= 0, "bpe_encode_word: output capacity exceeded"
+        return out[:n].tolist()
+
+    def __del__(self):
+        lib, handle = getattr(self, "_lib", None), getattr(self, "_handle", None)
+        if lib is not None and handle:
+            lib.bpe_destroy(handle)
+            self._handle = None
 
 
 def batch_collate(samples: list, nthreads: int = 0) -> Optional[np.ndarray]:

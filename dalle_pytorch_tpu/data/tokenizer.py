@@ -126,10 +126,36 @@ class SimpleTokenizer(_TokenizerBase):
         self.bpe_ranks = {m: i for i, m in enumerate(merges)}
         self.vocab_size = len(vocab)
         self._cache = {self.SOT: self.SOT, self.EOT: self.EOT}
+        # native merge engine (id-space BPE loop in C++, native/host_ops.cpp),
+        # created lazily on first encode() so construction never waits on a
+        # library build; None after creation failed -> pure-Python fallback
+        # Keep only well-formed, *reachable* rules: a pair can only ever
+        # fire if both pieces are themselves vocab symbols (byte chars or
+        # earlier merge results), so dropping the rest is semantics-free —
+        # relative rank order, all that greedy merging consults, survives.
+        self._rules = [m for m in merges
+                       if len(m) == 2 and m[0] in self.encoder
+                       and m[1] in self.encoder]
+        self._native = None
+        self._native_tried = False
+        self._native_cache: dict = {}
         self.pat = re.compile(
             r"""<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d|[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+""",
             re.IGNORECASE,
         )
+
+    @property
+    def _engine(self):
+        if not self._native_tried:
+            self._native_tried = True
+            if self._rules:
+                from .native import BpeEngine
+
+                self._native = BpeEngine.create(
+                    [self.encoder[a] for a, _ in self._rules],
+                    [self.encoder[b] for _, b in self._rules],
+                    [self.encoder[a + b] for a, b in self._rules])
+        return self._native
 
     def _bpe(self, token: str) -> str:
         if token in self._cache:
@@ -160,12 +186,30 @@ class SimpleTokenizer(_TokenizerBase):
         self._cache[token] = out
         return out
 
+    def _bpe_ids_native(self, token: str):
+        """Merged BPE ids for one pre-tokenized word via the native engine:
+        byte symbols map straight to vocab ids (last one carries </w>), the
+        C++ merge loop does the rest — no string splits/joins."""
+        cached = self._native_cache.get(token)
+        if cached is not None:
+            return cached
+        symbols = [self.encoder[c] for c in token[:-1]]
+        symbols.append(self.encoder[token[-1] + "</w>"])
+        out = self._engine.encode_word(symbols)
+        self._native_cache[token] = out
+        return out
+
     def encode(self, text: str):
         ids = []
         text = whitespace_clean(basic_clean(text)).lower()
         for token in re.findall(self.pat, text):
             token = "".join(self.byte_encoder[b] for b in token.encode("utf-8"))
-            ids.extend(self.encoder[t] for t in self._bpe(token).split(" "))
+            if token in (self.SOT, self.EOT):
+                ids.append(self.encoder[token])
+            elif self._engine is not None:
+                ids.extend(self._bpe_ids_native(token))
+            else:
+                ids.extend(self.encoder[t] for t in self._bpe(token).split(" "))
         return ids
 
     def decode(self, tokens, remove_start_end: bool = True) -> str:

@@ -23,6 +23,7 @@
 #include <cstdint>
 #include <cstring>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -184,7 +185,76 @@ void batch_collate_f32(const float* const* srcs, int n, int64_t elems,
   for (auto& th : threads) th.join();
 }
 
+// ---------------------------------------------------------------------------
+// Byte-level BPE merge engine (tokenizer hot loop).
+//
+// The CLIP SimpleTokenizer's per-word merge loop (greedy lowest-rank
+// adjacent-pair merging) runs entirely in vocab-id space: every
+// intermediate symbol a BPE word can contain is itself a vocab entry, so
+// the Python side maps bytes -> ids once and this engine does the merging
+// without any string work.  Exact semantic parity with the Python loop
+// (dalle_pytorch_tpu/data/tokenizer.py::SimpleTokenizer._bpe): pick the
+// lowest-rank adjacent bigram, merge ALL its occurrences left-to-right,
+// repeat until no mergeable bigram remains.
+
+struct BpeTable {
+  // (a << 32 | b) -> (rank, merged id)
+  std::unordered_map<uint64_t, std::pair<int32_t, int32_t>> merges;
+};
+
+void* bpe_create(int n_merges, const int32_t* a, const int32_t* b,
+                 const int32_t* merged) {
+  auto* t = new BpeTable();
+  t->merges.reserve((size_t)n_merges * 2);
+  for (int r = 0; r < n_merges; ++r) {
+    uint64_t key = ((uint64_t)(uint32_t)a[r] << 32) | (uint32_t)b[r];
+    // duplicates: last occurrence wins, matching the Python rank dict
+    t->merges[key] = std::make_pair(r, merged[r]);
+  }
+  return t;
+}
+
+void bpe_destroy(void* handle) { delete (BpeTable*)handle; }
+
+// word: n symbol ids in, merged ids out (in place safe: out may alias word).
+// Returns the output length (always <= n; n <= out_cap required).
+int bpe_encode_word(void* handle, const int32_t* word, int n, int32_t* out,
+                    int out_cap) {
+  const auto& merges = ((BpeTable*)handle)->merges;
+  if (n > out_cap) return -1;
+  std::vector<int32_t> w(word, word + n);
+  while (w.size() >= 2) {
+    int best_rank = INT32_MAX;
+    int32_t best_merged = -1;
+    uint64_t best_key = 0;
+    for (size_t i = 0; i + 1 < w.size(); ++i) {
+      uint64_t key = ((uint64_t)(uint32_t)w[i] << 32) | (uint32_t)w[i + 1];
+      auto it = merges.find(key);
+      if (it != merges.end() && it->second.first < best_rank) {
+        best_rank = it->second.first;
+        best_merged = it->second.second;
+        best_key = key;
+      }
+    }
+    if (best_merged < 0) break;
+    int32_t first = (int32_t)(best_key >> 32);
+    int32_t second = (int32_t)(uint32_t)best_key;
+    size_t j = 0;
+    for (size_t i = 0; i < w.size();) {
+      if (i + 1 < w.size() && w[i] == first && w[i + 1] == second) {
+        w[j++] = best_merged;
+        i += 2;
+      } else {
+        w[j++] = w[i++];
+      }
+    }
+    w.resize(j);
+  }
+  std::copy(w.begin(), w.end(), out);
+  return (int)w.size();
+}
+
 // Version probe for the ctypes loader.
-int dalle_host_ops_version() { return 2; }
+int dalle_host_ops_version() { return 3; }
 
 }  // extern "C"

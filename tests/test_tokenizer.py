@@ -78,3 +78,26 @@ def test_hug_tokenizer_cub():
     assert (out[0, : len(ids)] == np.asarray(ids)).all()
     decoded = tok.decode(out[0])
     assert "bird" in decoded
+
+
+def test_native_bpe_matches_python(synthetic_bpe):
+    """The C++ id-space merge engine must produce exactly the Python
+    _bpe loop's ids on a fuzz corpus (native/host_ops.cpp parity)."""
+    import random
+
+    tok = SimpleTokenizer(synthetic_bpe)
+    if tok._engine is None:  # lazy property: triggers the load/build
+        import pytest
+
+        pytest.skip("native library unavailable")
+
+    rng = random.Random(0)
+    words = ["hello", "world", "helloworld", "h", "he", "hell", "hellllo",
+             "ox", "wwoorrlldd"]
+    words += ["".join(rng.choice("helowrd") for _ in range(rng.randint(1, 12)))
+              for _ in range(200)]
+    for w in words:
+        token = "".join(tok.byte_encoder[b] for b in w.encode("utf-8"))
+        py_ids = [tok.encoder[t] for t in tok._bpe(token).split(" ")]
+        native_ids = tok._bpe_ids_native(token)
+        assert native_ids == py_ids, (w, native_ids, py_ids)
