@@ -323,17 +323,31 @@ def main(argv=None):
     t0 = time.perf_counter()
     for epoch in range(start_epoch, EPOCHS):
         epoch_losses = []
+        # one-step-deferred loss logging: materializing the loss each step
+        # would block the host on the device (and the device on the host's
+        # data loading + log IO).  The pmean dispatch is async; float() of
+        # step i's loss happens after step i+1 is already in flight.
+        pending = None  # (iter index, device loss)
+
+        def flush(pending):
+            if pending is None:
+                return
+            it, loss_dev = pending
+            # average_all here, not at dispatch: the multi-host impl blocks
+            # (process_allgather), which would kill the one-step deferral
+            avg_loss = float(distr_backend.average_all(loss_dev))
+            perf = timer.tick(BATCH_SIZE * jax.process_count())
+            epoch_losses.append(avg_loss)
+            logger.step(epoch, it, avg_loss, lr, extra=perf)
+
         for i, (text, images) in enumerate(dl):
             text_b, images_b = part.shard_batch((text.astype(np.int32), images))
             rng, step_rng = jax.random.split(rng)
             params, opt_state, loss = train_step(
                 params, opt_state, vae_params, text_b, images_b, step_rng)
 
-            # average_all syncs on the loss, so the timer sees real step time
-            avg_loss = float(distr_backend.average_all(loss))
-            perf = timer.tick(BATCH_SIZE * jax.process_count())
-            epoch_losses.append(avg_loss)
-            logger.step(epoch, i, avg_loss, lr, extra=perf)
+            flush(pending)
+            pending = (i, loss)  # raw device loss; averaged lazily in flush
 
             if i % 100 == 0:
                 # periodic sample (ref :396-412): SPMD computation, so every
@@ -350,6 +364,7 @@ def main(argv=None):
                 save_model('./dalle.pt', epoch)
                 logger.save_file('./dalle.pt')  # wandb.save parity (ref :409)
             global_step += 1
+        flush(pending)
 
         # per-epoch plateau step on the epoch-mean loss (ref :415-416)
         epoch_loss = float(np.mean(epoch_losses)) if epoch_losses else float('inf')
