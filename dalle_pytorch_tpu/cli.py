@@ -21,6 +21,25 @@ from .models.dalle import generate_codes
 from .utils.checkpoint import load_checkpoint, migrate_qkv_kernels
 
 
+def enable_compilation_cache(path: Optional[str] = None) -> None:
+    """Persistent XLA compilation cache: TPU first-compiles run 20-40s, so
+    CLI reruns (resume, generate sweeps, genrank over checkpoint lists)
+    should pay that once.  Off when DALLE_TPU_NO_COMPILE_CACHE is set."""
+    import os
+
+    if os.environ.get("DALLE_TPU_NO_COMPILE_CACHE"):
+        return
+    path = path or os.environ.get(
+        "DALLE_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/dalle_tpu_xla"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except AttributeError as e:  # older jax without the knobs: run uncached
+        import sys
+
+        print(f"compilation cache unavailable: {e}", file=sys.stderr)
+
+
 def select_tokenizer(bpe_path: Optional[str], chinese: bool = False):
     """Tokenizer priority matching the reference (train_dalle.py:105-112):
     explicit BPE file > chinese > CLIP SimpleTokenizer.  The CLIP merges txt

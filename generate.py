@@ -27,7 +27,8 @@ from pathlib import Path
 import jax
 import numpy as np
 
-from dalle_pytorch_tpu.cli import (generate_chunked, load_dalle_checkpoint,
+from dalle_pytorch_tpu.cli import (enable_compilation_cache,
+                                   generate_chunked, load_dalle_checkpoint,
                                    make_decode_fn, select_tokenizer)
 from dalle_pytorch_tpu.utils.images import save_image
 
@@ -62,6 +63,7 @@ def parse_args(argv=None):
 
 
 def main(argv=None):
+    enable_compilation_cache()
     args = parse_args(argv)
     tokenizer = select_tokenizer(args.bpe_path, chinese=args.chinese)
     dalle, cfg, params, vae, vae_params = load_dalle_checkpoint(

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dalle_pytorch_tpu.cli import enable_compilation_cache
 from dalle_pytorch_tpu.models.clip import CLIP, CLIPConfig
 from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
 
@@ -229,6 +230,7 @@ def get_model_output(dalle_path, out_path, text, num_images, bpe_path,
 
 
 def main(argv=None):
+    enable_compilation_cache()
     from PIL import Image
 
     args = parse_args(argv)

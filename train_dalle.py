@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_pytorch_tpu import DALLE, DALLEConfig, DiscreteVAE, VAEConfig
-from dalle_pytorch_tpu.cli import host_fetch, select_tokenizer
+from dalle_pytorch_tpu.cli import host_fetch, select_tokenizer, enable_compilation_cache
 from dalle_pytorch_tpu.data.dataset import DataLoader, TextImageDataset
 from dalle_pytorch_tpu.models.dalle import generate_codes
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
@@ -110,6 +110,7 @@ def build_vae(args, distr_backend, resume_vae_params=None):
 
 
 def main(argv=None):
+    enable_compilation_cache()
     args = parse_args(argv)
 
     # constants (ref train_dalle.py:74-97); sweep/test overrides via

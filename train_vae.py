@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_pytorch_tpu import DiscreteVAE, VAEConfig
-from dalle_pytorch_tpu.cli import host_fetch
+from dalle_pytorch_tpu.cli import host_fetch, enable_compilation_cache
 from dalle_pytorch_tpu.data.dataset import DataLoader, ImageFolderDataset
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
 from dalle_pytorch_tpu.training import make_optimizer, make_vae_train_step, set_learning_rate
@@ -49,6 +49,7 @@ def parse_args(argv=None):
 
 
 def main(argv=None):
+    enable_compilation_cache()
     args = parse_args(argv)
 
     # constants (ref train_vae.py:42-59)
