@@ -8,14 +8,22 @@ by ``num_experts`` while the top-k router keeps each token's output a
 mixture of k experts.
 
 TPU-native design choices:
-* **dense one-hot dispatch** — combine weights are a [tokens, experts]
-  matrix multiplied through stacked expert kernels with einsum.  No
-  scatter/gather, no dynamic shapes: everything is MXU matmuls that GSPMD
-  shards cleanly.  NOTE: dense dispatch computes every expert for every
-  token, so FF *FLOPs* scale with ``num_experts`` (the savings are in
-  params-per-token statistics, not compute); capacity-factor token
-  dropping — the TPU trick that makes FLOPs scale with ``top_k`` — is the
-  designated later optimization.
+* **two dispatch modes**, both static-shaped and einsum-only (no
+  scatter/gather, no dynamic shapes — everything is MXU matmuls that GSPMD
+  shards cleanly):
+  - ``dispatch='dense'``: every expert sees every token; the combine
+    matrix zeroes the non-routed outputs.  FF *FLOPs* scale with
+    ``num_experts`` — simplest and exact, right at small expert counts.
+  - ``dispatch='capacity'``: GShard/Switch-style fixed expert capacity
+    within token *groups* of ``capacity_group`` tokens: per group,
+    ``C = ceil(top_k · g / e · capacity_factor)`` slots per expert.
+    One-hot dispatch/combine tensors [G, g, e, C] route each token to a
+    slot (position-in-expert via cumsum, no sort); tokens over a group's
+    capacity are DROPPED for that expert (their residual passes
+    through).  Grouping keeps dispatch memory and FLOPs linear in token
+    count (≈ T·k·cf·g dispatch-matmul elements) — ungrouped [T, e, C]
+    dispatch would be quadratic in T.  Expert FF FLOPs scale with
+    ``top_k · capacity_factor`` instead of ``num_experts``.
 * **expert parallelism by sharding annotation** — expert-stacked kernels
   carry a leading ``num_experts`` axis; `Partitioner`-style regex rules or
   an explicit `with_sharding_constraint` put that axis on an ``ep`` mesh
@@ -48,14 +56,50 @@ class MoEFeedForward(nn.Module):
     top_k: int = 2
     mult: int = 4
     dropout: float = 0.0   # on the expert inner activations (FFBlock parity)
+    dispatch: str = "dense"        # 'dense' | 'capacity'
+    capacity_factor: float = 1.25  # only used by 'capacity' dispatch
+    capacity_group: int = 1024     # tokens per dispatch group ('capacity')
     dtype: Any = jnp.float32
+
+    def _expert_geglu(self, deterministic):
+        """Returns the stacked-expert GEGLU: input flows through per-expert
+        kernels with the expert axis named 'e' in the caller's einsum
+        specs."""
+        e, d = self.num_experts, self.dim
+        inner = int(d * self.mult)
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, d, inner * 2)).astype(self.dtype)
+        b_in = self.param("b_in", nn.initializers.zeros,
+                          (e, inner * 2)).astype(self.dtype)
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, inner, d)).astype(self.dtype)
+        b_out = self.param("b_out", nn.initializers.zeros,
+                           (e, d)).astype(self.dtype)
+
+        def ff(h, in_spec, out_spec, expert_leading=False):
+            # biases align on (e, last): in the [e, C, ...] layout the
+            # expert axis leads, so give them a slot axis to broadcast over
+            bi = b_in[:, None] if expert_leading else b_in
+            bo = b_out[:, None] if expert_leading else b_out
+            h = jnp.einsum(in_spec, h, w_in) + bi
+            h, gates = jnp.split(h, 2, axis=-1)
+            h = h * nn.gelu(gates)
+            # dropout on the inner activation, matching FFBlock's placement
+            # (between the GEGLU gate and the output projection)
+            h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+            return jnp.einsum(out_spec, h, w_out) + bo
+
+        return ff
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         """x: [b, n, dim] -> (y: [b, n, dim], aux_loss: scalar f32)."""
-        e, d = self.num_experts, self.dim
-        inner = int(d * self.mult)
+        e = self.num_experts
         k = min(self.top_k, e)
+        assert self.dispatch in ("dense", "capacity"), (
+            f"unknown MoE dispatch {self.dispatch!r}")
 
         # --- router (f32 for a stable softmax) ---
         router = nn.Dense(e, dtype=jnp.float32, name="router")
@@ -74,30 +118,54 @@ class MoEFeedForward(nn.Module):
         top1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e, dtype=jnp.float32)
         aux = (top1.mean(axis=(0, 1)) * probs.mean(axis=(0, 1))).sum() * e
 
-        # --- expert-stacked GEGLU kernels: leading axis e shards on 'ep' ---
-        w_in = self.param(
-            "w_in", nn.initializers.lecun_normal(batch_axis=(0,)),
-            (e, d, inner * 2)).astype(self.dtype)
-        b_in = self.param("b_in", nn.initializers.zeros,
-                          (e, inner * 2)).astype(self.dtype)
-        w_out = self.param(
-            "w_out", nn.initializers.lecun_normal(batch_axis=(0,)),
-            (e, inner, d)).astype(self.dtype)
-        b_out = self.param("b_out", nn.initializers.zeros,
-                           (e, d)).astype(self.dtype)
-
+        ff = self._expert_geglu(deterministic)
         xc = x.astype(self.dtype)
-        # dense dispatch: every expert sees every token; the combine matrix
-        # zeroes the non-routed ones.  [b, n, d] x [e, d, 2i] -> [b, n, e, 2i]
-        h = jnp.einsum("bnd,edi->bnei", xc, w_in) + b_in
-        h, gates = jnp.split(h, 2, axis=-1)
-        h = h * nn.gelu(gates)
-        # dropout on the inner activation, matching FFBlock's placement
-        # (between the GEGLU gate and the output projection)
-        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
-        y = jnp.einsum("bnei,eid->bned", h, w_out) + b_out  # [b, n, e, d]
-        y = jnp.einsum("bned,bne->bnd", y, combine.astype(self.dtype))
-        return y.astype(x.dtype), aux.astype(jnp.float32)
+
+        if self.dispatch == "dense":
+            # every expert sees every token; combine zeroes the non-routed
+            y = ff(xc, "bnd,edi->bnei", "bnei,eid->bned")  # [b, n, e, d]
+            y = jnp.einsum("bned,bne->bnd", y, combine.astype(self.dtype))
+            return y.astype(x.dtype), aux.astype(jnp.float32)
+
+        # --- capacity dispatch (GShard/Switch): per-group C slots/expert ---
+        b, n, d = x.shape
+        T = b * n
+        g = min(self.capacity_group, T)
+        G = -(-T // g)  # ceil
+        Tp = G * g
+        C = max(1, int(-(-k * g * self.capacity_factor // e)))  # ceil
+
+        def pad(arr):
+            return jnp.pad(arr, ((0, Tp - T),) + ((0, 0),) * (arr.ndim - 1))
+
+        flat_gate = pad(combine.reshape(T, e)).reshape(G, g, e)
+        flat_idx = pad(top_idx.reshape(T, k)).reshape(G, g, k)
+        xf = pad(xc.reshape(T, d)).reshape(G, g, d)
+        # padding tokens must not consume capacity slots
+        valid = pad(jnp.ones((T, 1), jnp.int32)).reshape(G, g, 1)
+
+        # slot assignment: per routing priority j, position-in-expert via a
+        # cumulative count over token order within the group (no sort,
+        # static shapes); one_hot(pos, C) is all-zero past capacity, which
+        # is exactly the drop
+        counts = jnp.zeros((G, e), jnp.int32)
+        dispatch = jnp.zeros((G, g, e, C), self.dtype)
+        for j in range(k):
+            oh = jax.nn.one_hot(flat_idx[..., j], e, dtype=jnp.int32) * valid
+            pos = jnp.cumsum(oh, axis=1) - oh + counts[:, None]   # [G, g, e]
+            pos_tok = (pos * oh).sum(-1)                          # [G, g]
+            slot = jax.nn.one_hot(pos_tok, C, dtype=self.dtype)   # [G, g, C]
+            dispatch = dispatch + (oh.astype(self.dtype)[..., None]
+                                   * slot[:, :, None, :])
+            counts = counts + oh.sum(axis=1)
+
+        combine_slots = dispatch * flat_gate.astype(self.dtype)[..., None]
+        expert_in = jnp.einsum("gtec,gtd->gecd", dispatch, xf)  # [G, e, C, d]
+        y = ff(expert_in, "gecd,edi->geci", "geci,eid->gecd",
+               expert_leading=True)                             # [G, e, C, d]
+        out = jnp.einsum("gtec,gecd->gtd", combine_slots, y)    # dropped -> 0
+        out = out.reshape(Tp, d)[:T]
+        return out.reshape(b, n, d).astype(x.dtype), aux.astype(jnp.float32)
 
 
 def ep_shard_moe_params(params: dict, mesh, ep_axis: str = "ep"):

@@ -124,6 +124,9 @@ class MoEFFBlock(nn.Module):
     top_k: int = 2
     mult: int = 4
     dropout: float = 0.0
+    dispatch: str = "dense"
+    capacity_factor: float = 1.25
+    capacity_group: int = 1024
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -132,7 +135,9 @@ class MoEFFBlock(nn.Module):
         self.norm = nn.LayerNorm(dtype=jnp.float32, name="norm")
         self.moe = MoEFeedForward(
             dim=self.dim, num_experts=self.num_experts, top_k=self.top_k,
-            mult=self.mult, dropout=self.dropout, dtype=self.dtype,
+            mult=self.mult, dropout=self.dropout, dispatch=self.dispatch,
+            capacity_factor=self.capacity_factor,
+            capacity_group=self.capacity_group, dtype=self.dtype,
             name="moe")
         self.scale = self.param(
             "scale",
@@ -171,6 +176,9 @@ class Transformer(nn.Module):
     sp_impl: str = "ring"            # 'ring' | 'ulysses' (all-to-all)
     ff_experts: int = 0        # >1: MoE feed-forward with this many experts
     ff_expert_top_k: int = 2
+    ff_expert_dispatch: str = "dense"        # 'dense' | 'capacity'
+    ff_expert_capacity_factor: float = 1.25
+    ff_expert_capacity_group: int = 1024
     sparse_layout_seed: int = 0
     dtype: Any = jnp.float32
 
@@ -203,6 +211,9 @@ class Transformer(nn.Module):
                     dim=self.dim, layer_index=ind + 1,
                     num_experts=self.ff_experts, top_k=self.ff_expert_top_k,
                     mult=self.ff_mult, dropout=self.ff_dropout,
+                    dispatch=self.ff_expert_dispatch,
+                    capacity_factor=self.ff_expert_capacity_factor,
+                    capacity_group=self.ff_expert_capacity_group,
                     dtype=self.dtype, name=f"layers_{ind}_ff",
                 ))
             else:
