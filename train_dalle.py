@@ -76,6 +76,9 @@ def parse_args(argv=None):
     parser.add_argument('--epochs', type=int, default=5,
                         help='training epochs (the reference hard-codes '
                              'EPOCHS=5 but its committed logs ran 100)')
+    parser.add_argument('--profile_dir', type=str, default=None,
+                        help='write a jax.profiler trace of steps 10-20 of '
+                             'the first epoch to this dir (XProf/TensorBoard)')
     parser = distributed_utils.wrap_arg_parser(parser)
     return parser.parse_args(argv)
 
@@ -321,6 +324,7 @@ def main(argv=None):
         dalle_cfg, BATCH_SIZE * jax.process_count()))
     lr = sched.lr
     global_step = 0
+    profiling_active = False
     t0 = time.perf_counter()
     for epoch in range(start_epoch, EPOCHS):
         epoch_losses = []
@@ -342,6 +346,21 @@ def main(argv=None):
             logger.step(epoch, it, avg_loss, lr, extra=perf)
 
         for i, (text, images) in enumerate(dl):
+            # profiler window: steps 10-20 of the first trained epoch (past
+            # compile + warmup), root process only (ref had no profiler at
+            # all — SURVEY.md §5.1)
+            if args.profile_dir and epoch == start_epoch and \
+                    distr_backend.is_root_worker():
+                window = (min(10, len(dl) - 2), min(20, len(dl) - 1)) \
+                    if len(dl) >= 2 else (None, None)
+                if i == window[0]:
+                    jax.profiler.start_trace(args.profile_dir)
+                    profiling_active = True
+                elif i == window[1] and profiling_active:
+                    jax.block_until_ready(params)
+                    jax.profiler.stop_trace()
+                    profiling_active = False
+                    print(f'profiler trace written to {args.profile_dir}')
             text_b, images_b = part.shard_batch((text.astype(np.int32), images))
             rng, step_rng = jax.random.split(rng)
             params, opt_state, loss = train_step(
