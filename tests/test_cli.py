@@ -183,6 +183,36 @@ def test_generate_cli(trained_dalle, tiny_tokenizer_json, workdir):
     assert len(jpgs) == 2
 
 
+def test_generate_cli_pickle_eval_mode(trained_dalle, tiny_tokenizer_json,
+                                       tmp_path):
+    """Eval mode (no --text): generate for every caption of a pickled
+    pandas DataFrame in big batches (ref generate.py:118-156)."""
+    import pandas as pd
+
+    df = pd.DataFrame({
+        "caption": ["red bird", "blue square", "green circle"],
+        "fname": ["a.jpg", "b.jpg", "c.jpg"],
+        "name": ["a", "b", "c"],
+    })
+    pkl = tmp_path / "caps.pkl"
+    df.to_pickle(pkl)
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        import generate
+
+        generate.main(["--dalle_path", str(trained_dalle),
+                       "--captions_pickle", str(pkl),
+                       "--batch_size", "2",
+                       "--bpe_path", str(tiny_tokenizer_json),
+                       "--outputs_dir", str(tmp_path / "eval_out")])
+    finally:
+        os.chdir(cwd)
+    jpgs = list((tmp_path / "eval_out").glob("*.jpg"))
+    assert len(jpgs) == 3  # one image per caption
+
+
 def test_genrank_cli_with_clip_vit(trained_dalle, tiny_tokenizer_json,
                                    workdir):
     """Ranking through a converted-official-CLIP-style (CLIPViT) ranker."""
@@ -345,3 +375,24 @@ def test_legacy_qkv_checkpoint_migration():
     again = migrate_qkv_kernels(out, dim_head=dh)
     assert again["transformer"]["layers_0_attn"]["attn"]["to_qkv"][
         "kernel"].shape == (d, 3, h, dh)
+
+
+def test_analyze_logs_cli(tmp_path, capsys):
+    """Per-epoch mean/std summary + CSV from `epoch iter loss lr` logs
+    (script equivalent of the reference's analysis notebook)."""
+    log = tmp_path / "run-a.txt"
+    rows = []
+    for e in range(2):
+        for i in range(5):
+            rows.append(f"{e} {i} {4.0 - e - 0.1 * i} 0.001")
+    log.write_text("\n".join(rows) + "\n")
+
+    import analyze_logs
+
+    csv = tmp_path / "summary.csv"
+    analyze_logs.main([str(log), "--csv", str(csv)])
+    out = capsys.readouterr().out
+    assert "run-a" in out and "10 steps" in out and "2 epochs" in out
+    lines = csv.read_text().strip().split("\n")
+    assert len(lines) == 3  # header + 2 epochs
+    assert lines[0].split(",")[:2] == ["run", "epoch"]
