@@ -187,7 +187,7 @@ def test_generate_cli_pickle_eval_mode(trained_dalle, tiny_tokenizer_json,
                                        tmp_path):
     """Eval mode (no --text): generate for every caption of a pickled
     pandas DataFrame in big batches (ref generate.py:118-156)."""
-    import pandas as pd
+    pd = pytest.importorskip("pandas")
 
     df = pd.DataFrame({
         "caption": ["red bird", "blue square", "green circle"],
@@ -197,18 +197,14 @@ def test_generate_cli_pickle_eval_mode(trained_dalle, tiny_tokenizer_json,
     pkl = tmp_path / "caps.pkl"
     df.to_pickle(pkl)
 
-    cwd = os.getcwd()
-    os.chdir(tmp_path)
-    try:
-        import generate
+    import generate
 
-        generate.main(["--dalle_path", str(trained_dalle),
-                       "--captions_pickle", str(pkl),
-                       "--batch_size", "2",
-                       "--bpe_path", str(tiny_tokenizer_json),
-                       "--outputs_dir", str(tmp_path / "eval_out")])
-    finally:
-        os.chdir(cwd)
+    # every path is absolute, so no cwd dance is needed in eval mode
+    generate.main(["--dalle_path", str(trained_dalle),
+                   "--captions_pickle", str(pkl),
+                   "--batch_size", "2",
+                   "--bpe_path", str(tiny_tokenizer_json),
+                   "--outputs_dir", str(tmp_path / "eval_out")])
     jpgs = list((tmp_path / "eval_out").glob("*.jpg"))
     assert len(jpgs) == 3  # one image per caption
 
