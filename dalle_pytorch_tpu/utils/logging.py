@@ -71,15 +71,21 @@ class TrainLogger:
             self.run.log(payload)
 
     def save_file(self, path: str):
-        """wandb.save parity (ref train_dalle.py:409, train_vae.py:221)."""
-        if self.is_root and self.run is not None:
+        """wandb.save parity (ref train_dalle.py:409, train_vae.py:221).
+        Directory checkpoints (Orbax) are skipped — wandb.save wants files;
+        they go up via log_artifact instead."""
+        if self.is_root and self.run is not None and Path(path).is_file():
             _wandb.save(path)
 
     def log_artifact(self, path: str, name: str, type_: str = "model"):
-        """wandb.Artifact upload parity (ref train_vae.py:241-253)."""
+        """wandb.Artifact upload parity (ref train_vae.py:241-253); handles
+        both file (msgpack) and directory (Orbax) checkpoints."""
         if self.is_root and self.run is not None:
             art = _wandb.Artifact(name, type=type_)
-            art.add_file(path)
+            if Path(path).is_dir():
+                art.add_dir(path)
+            else:
+                art.add_file(path)
             self.run.log_artifact(art)
 
     def finish(self):

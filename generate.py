@@ -91,7 +91,12 @@ def main(argv=None):
             print(f'created {args.num_images} images at "{outputs_dir}"')
     else:
         # eval mode over a pickled caption DataFrame (ref :118-156)
-        import pandas as pd
+        try:
+            import pandas as pd
+        except ImportError as e:
+            raise SystemExit(
+                "eval mode needs pandas: pip install 'dalle-pytorch-tpu[eval]'"
+            ) from e
 
         cap_df = pd.read_pickle(args.captions_pickle)
         all_tokens = tokenizer.tokenize(

@@ -392,3 +392,36 @@ def test_analyze_logs_cli(tmp_path, capsys):
     lines = csv.read_text().strip().split("\n")
     assert len(lines) == 3  # header + 2 epochs
     assert lines[0].split(",")[:2] == ["run", "epoch"]
+
+
+def test_train_dalle_sharded_checkpoints(trained_vae, tiny_dataset,
+                                         tiny_tokenizer_json, tmp_path):
+    """--sharded_checkpoints writes Orbax dirs ({name}.orbax, per-host
+    shard IO) and resume accepts the directory transparently."""
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps(DALLE_HPARAMS)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        import train_dalle
+
+        train_dalle.main(["--vae_path", str(trained_vae),
+                          "--image_text_folder", str(tiny_dataset),
+                          "--bpe_path", str(tiny_tokenizer_json),
+                          "--truncate_captions", "--epochs", "1",
+                          "--sharded_checkpoints"])
+        final = tmp_path / "dalle-final.pt.orbax"
+        assert final.is_dir()
+
+        # resume from the Orbax directory
+        train_dalle.main(["--dalle_path", str(final),
+                          "--image_text_folder", str(tiny_dataset),
+                          "--bpe_path", str(tiny_tokenizer_json),
+                          "--truncate_captions", "--epochs", "2",
+                          "--sharded_checkpoints"])
+    finally:
+        os.chdir(cwd)
+        del os.environ["DALLE_TPU_HPARAMS"]
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    ckpt = load_checkpoint(tmp_path / "dalle-final.pt.orbax")
+    assert int(ckpt["epoch"]) == 2

@@ -79,6 +79,11 @@ def parse_args(argv=None):
     parser.add_argument('--profile_dir', type=str, default=None,
                         help='write a jax.profiler trace of steps 10-20 of '
                              'the first epoch to this dir (XProf/TensorBoard)')
+    parser.add_argument('--sharded_checkpoints', action='store_true',
+                        help='save Orbax sharded checkpoint dirs '
+                             '({name}.orbax) with per-host shard IO instead '
+                             'of gathering to process 0 (for multi-host '
+                             'scale); load sites accept both formats')
     parser = distributed_utils.wrap_arg_parser(parser)
     return parser.parse_args(argv)
 
@@ -173,6 +178,12 @@ def main(argv=None):
         dalle_path = Path(args.dalle_path)
         assert dalle_path.exists(), 'DALL-E model file does not exist'
         resume_ckpt = load_checkpoint(dalle_path)
+        # Orbax restores device-placed arrays whose shardings predate this
+        # run's Partitioner; normalize to host numpy so the standard
+        # shard_params/opt-template flow below re-places everything
+        resume_ckpt = jax.tree.map(
+            lambda v: np.asarray(v) if hasattr(v, 'devices') else v,
+            resume_ckpt)
         resume_vae = resume_ckpt.get('vae_params')
         vae, vae_geom, vae_hparams, vae_weights = build_vae(
             args, distr_backend,
@@ -296,6 +307,24 @@ def main(argv=None):
         return vae.decode(codes)
 
     def save_model(path, epoch):
+        if args.sharded_checkpoints:
+            # Orbax writes each host's shards directly — no gather; every
+            # process participates collectively
+            from dalle_pytorch_tpu.utils.checkpoint import \
+                save_checkpoint_sharded
+
+            payload = {
+                'hparams': dalle_cfg.to_dict(),
+                'vae_params': vae_hparams,
+                'weights': params,
+                'opt_state': jax.tree.leaves(opt_state),
+                'scheduler': sched.state_dict(),
+                'epoch': epoch,
+            }
+            if is_custom_vae and vae_params is not None:
+                payload['vae_weights'] = vae_params
+            save_checkpoint_sharded(f'{path}.orbax', payload)
+            return
         # every process participates in the fetch (sharded params span
         # non-addressable devices multi-host); only root writes
         weights = host_fetch(params)
@@ -382,7 +411,8 @@ def main(argv=None):
                     decoded = tokenizer.decode(np.asarray(text[0]))
                     logger.log({'image_caption': decoded})
                 save_model('./dalle.pt', epoch)
-                logger.save_file('./dalle.pt')  # wandb.save parity (ref :409)
+                # wandb.save parity (ref :409); no-op for .orbax dirs
+                logger.save_file('./dalle.pt')
             global_step += 1
         flush(pending)
 
@@ -400,7 +430,9 @@ def main(argv=None):
     save_model('./dalle-final.pt', EPOCHS)
     if distr_backend.is_root_worker():
         # wandb artifact upload parity (ref train_dalle.py:430-437)
-        logger.log_artifact('./dalle-final.pt', 'trained-dalle')
+        final_path = ('./dalle-final.pt.orbax' if args.sharded_checkpoints
+                      else './dalle-final.pt')
+        logger.log_artifact(final_path, 'trained-dalle')
     logger.finish()
 
 
