@@ -1,0 +1,208 @@
+"""Failure detection & preemption-safe training.
+
+The reference has no failure handling (SURVEY.md §5.3): recovery is "rerun
+``train_dalle.py --dalle_path ./dalle.pt``" and a preempted run silently
+loses everything since the last 100-iter checkpoint, while a hung
+collective or dead host is invisible until the scheduler kills the job.
+TPU pods make both failure modes routine (preemptible capacity, multi-host
+collectives), so this framework makes them first-class:
+
+* ``GracefulShutdown`` converts SIGTERM/SIGINT — the preemption notice every
+  scheduler sends before the hard kill — into a cooperative stop flag the
+  training loop polls at step boundaries, so the loop can write a final
+  resume checkpoint and exit cleanly.  In multi-host runs the flag is made
+  *collective* (any-process OR via the backend's ``average_all``) so every
+  process leaves the loop at the same step — required because the
+  checkpoint save paths (``host_fetch`` gathers, Orbax sharded writes) are
+  collective operations that deadlock if only one process calls them.
+* ``Heartbeat`` writes a small per-process progress file (atomic
+  rename) at most once per ``beat_interval`` seconds and optionally runs an
+  in-process
+  watchdog thread that warns on stderr when no step has completed for
+  ``stall_timeout`` seconds — catching hung device steps / collectives from
+  *inside* the process, while the files let an external monitor detect a
+  dead or wedged host by mtime age (``Heartbeat.is_stalled``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class GracefulShutdown:
+    """Context manager turning termination signals into a pollable stop flag.
+
+    A second delivery of the same signal restores the previous handler and
+    re-raises, so an impatient ``kill`` (or ctrl-C twice) still terminates
+    immediately instead of waiting for the checkpoint.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._previous = {}
+        self._requested = False
+
+    # --- signal plumbing ---
+
+    def _handler(self, signum, frame):
+        if self._requested:  # second signal: escalate to the old behavior
+            self._restore()
+            signal.raise_signal(signum)
+            return
+        self._requested = True
+        print(f"[failure] received signal {signum}: will checkpoint and "
+              "stop at the next step boundary (send again to force-quit)",
+              file=sys.stderr, flush=True)
+
+    def _restore(self):
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous = {}
+
+    def __enter__(self) -> "GracefulShutdown":
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        self._restore()
+        return False
+
+    # --- polling API ---
+
+    @property
+    def requested(self) -> bool:
+        """This process's local flag (no collective)."""
+        return self._requested
+
+    def should_stop(self, backend=None, step: Optional[int] = None,
+                    check_every: int = 1) -> bool:
+        """Collective stop decision, safe to act on with collective saves.
+
+        Single-process: just the local flag.  Multi-process: every
+        ``check_every`` steps all processes agree on OR(local flags) via the
+        backend's ``average_all`` (flags are 0/1, so mean > 0 iff any set).
+        The default checks *every* step — the collective is a single scalar
+        (microseconds over ICI/DCN, negligible next to any real train step)
+        and it bounds signal-to-checkpoint latency to one step, which
+        matters inside a preemption grace window.  A larger ``check_every``
+        must be called symmetrically by every process — pass the global
+        step so the modulo lines up.
+        """
+        if jax.process_count() <= 1 or backend is None:
+            return self._requested
+        if step is not None and check_every > 1 and step % check_every != 0:
+            return False
+        flag = np.float32(1.0 if self._requested else 0.0)
+        return float(backend.average_all(flag)) > 0.0
+
+
+class Heartbeat:
+    """Per-process progress file + optional in-process stall watchdog."""
+
+    def __init__(self, directory, beat_interval: float = 15.0,
+                 stall_timeout: Optional[float] = None):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.path = self.dir / f"heartbeat-p{jax.process_index()}.json"
+        self.beat_interval = float(beat_interval)
+        # None until the first beat: the stretch from construction to step 1
+        # includes the XLA compile (minutes at real sizes), which must not
+        # read as a stall
+        self._last_beat = None
+        self._last_write = None  # monotonic time of the last file write
+        self._last_step = 0
+        self._stop = threading.Event()
+        self._thread = None
+        self._stalled_since = None
+        if stall_timeout:
+            self._timeout = float(stall_timeout)
+            self._thread = threading.Thread(
+                target=self._watch, name="heartbeat-watchdog", daemon=True)
+            self._thread.start()
+
+    def beat(self, step: int, **extra) -> None:
+        """Record a completed step.  The file write is rate-limited by
+        *time* (``beat_interval`` seconds), not by step count — external
+        monitors judge staleness by wall-clock age, so a slow-but-healthy
+        run (minutes per step) must still look alive.  The first beat
+        always writes so monitors see the file immediately."""
+        now = time.monotonic()
+        self._last_beat = now
+        self._last_step = int(step)
+        self._stalled_since = None
+        if (self._last_write is not None
+                and now - self._last_write < self.beat_interval):
+            return
+        self._last_write = now
+        self._write({"step": int(step), "time": time.time(),
+                     "process": jax.process_index(), **extra})
+
+    def _write(self, payload: dict) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".hb-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)  # atomic on POSIX
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            finally:
+                raise
+
+    def _watch(self) -> None:
+        while not self._stop.wait(min(self._timeout / 4, 1.0)):
+            if self._last_beat is None:  # still compiling step 1
+                continue
+            age = time.monotonic() - self._last_beat
+            if age > self._timeout and self._stalled_since is None:
+                self._stalled_since = time.monotonic()
+                print(f"[failure] possible stall: no training step for "
+                      f"{age:.0f}s (timeout {self._timeout:.0f}s) — a hung "
+                      "collective or device step?", file=sys.stderr, flush=True)
+
+    def close(self, done: bool = False) -> None:
+        """Stop the watchdog.  ``done=True`` stamps the heartbeat file with a
+        done marker so external monitors can tell a *finished* run from a
+        dead one (otherwise the aging heartbeat of a completed run reads as
+        STALLED and an auto-restart wrapper would relaunch it forever).
+        Interrupted/preempted runs close with ``done=False`` on purpose —
+        there a restart is exactly what the babysitter should do."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if done:
+            self._write({"step": self._last_step, "time": time.time(),
+                         "process": jax.process_index(), "done": True})
+
+    # --- external-monitor side ---
+
+    @staticmethod
+    def read(path) -> dict:
+        return json.loads(Path(path).read_text())
+
+    @staticmethod
+    def is_stalled(path, timeout: float, now: Optional[float] = None) -> bool:
+        """True if the heartbeat file is older than ``timeout`` seconds (or
+        missing) — for an external babysitter scanning ``heartbeat-p*.json``
+        to find dead/wedged hosts."""
+        path = Path(path)
+        if not path.exists():
+            return True
+        now = time.time() if now is None else now
+        try:
+            last = Heartbeat.read(path)["time"]
+        except (json.JSONDecodeError, KeyError):  # mid-write torn read
+            last = path.stat().st_mtime
+        return (now - last) > timeout

@@ -1,0 +1,146 @@
+"""Failure-detection subsystem: graceful shutdown + heartbeat/stall watch.
+
+The reference has no failure handling (SURVEY.md §5.3 — recovery is a
+manual rerun from the last periodic checkpoint); these cover the
+preemption-safe machinery this framework adds.  The end-to-end
+SIGTERM-during-training path is covered in test_cli.py
+(test_train_dalle_preemption) on the real CLI.
+"""
+from __future__ import annotations
+
+import json
+import signal
+import time
+
+from dalle_pytorch_tpu.utils.failure import GracefulShutdown, Heartbeat
+
+
+def test_graceful_shutdown_sets_flag_on_signal():
+    with GracefulShutdown() as stopper:
+        assert not stopper.requested
+        assert not stopper.should_stop()
+        signal.raise_signal(signal.SIGTERM)
+        assert stopper.requested
+        assert stopper.should_stop()
+    # handlers restored on exit
+    assert signal.getsignal(signal.SIGTERM) is not stopper._handler
+
+
+def test_graceful_shutdown_sigint_too():
+    with GracefulShutdown() as stopper:
+        signal.raise_signal(signal.SIGINT)
+        assert stopper.requested
+    assert signal.getsignal(signal.SIGINT) is not stopper._handler
+
+
+def test_heartbeat_file_and_external_stall_check(tmp_path):
+    hb = Heartbeat(tmp_path, beat_interval=1000)
+    try:
+        # a missing heartbeat reads as stalled (dead-before-first-step host)
+        assert Heartbeat.is_stalled(hb.path, timeout=1.0)
+        hb.beat(1, epoch=0)  # first beat always writes
+        payload = Heartbeat.read(hb.path)
+        assert payload["step"] == 1 and payload["epoch"] == 0
+        # writes are rate-limited by wall-clock time, not step count
+        hb.beat(2)
+        assert Heartbeat.read(hb.path)["step"] == 1
+        hb._last_write -= 2000  # age past the rate limit
+        hb.beat(3)
+        assert Heartbeat.read(hb.path)["step"] == 3
+
+        now = time.time()
+        assert not Heartbeat.is_stalled(hb.path, timeout=60, now=now)
+        assert Heartbeat.is_stalled(hb.path, timeout=60, now=now + 120)
+    finally:
+        hb.close()
+
+
+def test_heartbeat_stall_check_survives_torn_file(tmp_path):
+    path = tmp_path / "heartbeat-p0.json"
+    path.write_text('{"step": 3, "ti')  # torn mid-write
+    # falls back to mtime: fresh file -> not stalled, old 'now' -> stalled
+    assert not Heartbeat.is_stalled(path, timeout=60)
+    assert Heartbeat.is_stalled(path, timeout=60, now=time.time() + 120)
+
+
+def test_watchdog_warns_on_stall(tmp_path, capfd):
+    hb = Heartbeat(tmp_path, stall_timeout=0.1)
+    try:
+        hb.beat(1)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if "possible stall" in capfd.readouterr().err:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("watchdog never warned about the stall")
+        # a new beat clears the stall latch so a second stall warns again
+        hb.beat(2)
+        assert hb._stalled_since is None
+    finally:
+        hb.close()
+
+
+def test_monitor_cli(tmp_path, capsys):
+    """tools/monitor.py scans heartbeat files: healthy -> 0, stalled -> 1,
+    empty dir -> 2, --expect reports never-started processes."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import monitor
+
+    assert monitor.main([str(tmp_path)]) == 2  # no heartbeats yet
+
+    hb = Heartbeat(tmp_path)
+    try:
+        hb.beat(7)
+    finally:
+        hb.close()
+    assert monitor.main([str(tmp_path), "--timeout", "300"]) == 0
+    out = capsys.readouterr().out
+    assert "process 0: ok" in out and "step 7" in out
+
+    # age the heartbeat beyond the timeout -> stalled
+    payload = json.loads(hb.path.read_text())
+    payload["time"] -= 1000
+    hb.path.write_text(json.dumps(payload))
+    assert monitor.main([str(tmp_path), "--timeout", "300"]) == 1
+    assert "STALLED" in capsys.readouterr().out
+
+    # --expect flags processes that never wrote a heartbeat
+    assert monitor.main([str(tmp_path), "--timeout", "1e9",
+                         "--expect", "3"]) == 1
+    assert "process 1: MISSING" in capsys.readouterr().out
+
+    # a done marker overrides staleness: finished runs must not read as
+    # dead (an auto-restart wrapper would relaunch them forever)
+    payload["done"] = True
+    hb.path.write_text(json.dumps(payload))
+    assert monitor.main([str(tmp_path), "--timeout", "300"]) == 0
+    assert "process 0: done" in capsys.readouterr().out
+
+
+def test_heartbeat_done_marker(tmp_path):
+    hb = Heartbeat(tmp_path)
+    hb.beat(42)
+    hb.close(done=True)
+    payload = Heartbeat.read(hb.path)
+    assert payload["done"] is True and payload["step"] == 42
+
+    # interrupted close leaves no done marker — restart is desired there
+    hb2 = Heartbeat(tmp_path)
+    hb2.beat(43)
+    hb2.close(done=False)
+    assert "done" not in Heartbeat.read(hb2.path)
+
+
+def test_watchdog_quiet_before_first_step(tmp_path, capfd):
+    """The construction->first-beat stretch includes the XLA compile
+    (minutes at real sizes) and must not read as a stall."""
+    hb = Heartbeat(tmp_path, stall_timeout=0.05)
+    try:
+        time.sleep(0.5)
+        assert "possible stall" not in capfd.readouterr().err
+    finally:
+        hb.close()

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""External training-run monitor: scan heartbeat files for dead/stalled hosts.
+
+The in-process side (``dalle_pytorch_tpu.utils.failure``) writes one
+``heartbeat-p{process}.json`` per host into ``--heartbeat_dir``; this tool is
+the babysitter that watches them from outside — e.g. under cron or a
+supervisor loop — and exits non-zero when any host has gone quiet, so a
+wrapper script can alert or restart the run.  (SURVEY.md §5.3: the reference
+has no failure detection at all.)
+
+Usage:
+    python tools/monitor.py HEARTBEAT_DIR [--timeout 300] [--expect N] [--watch S]
+
+Exit codes: 0 all hosts healthy, 1 stalled/missing hosts, 2 no heartbeats.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dalle_pytorch_tpu.utils.failure import Heartbeat  # noqa: E402
+
+
+def scan(directory: Path, timeout: float, expect: int | None) -> int:
+    files = sorted(directory.glob("heartbeat-p*.json"),
+                   key=lambda p: int(re.search(r"p(\d+)", p.stem).group(1)))
+    if not files:
+        print(f"no heartbeat files in {directory}", file=sys.stderr)
+        return 2
+
+    now = time.time()
+    bad = 0
+    seen = set()
+    for path in files:
+        proc = int(re.search(r"p(\d+)", path.stem).group(1))
+        seen.add(proc)
+        stalled = Heartbeat.is_stalled(path, timeout, now=now)
+        done = False
+        try:
+            info = Heartbeat.read(path)
+            done = bool(info.get("done"))
+            age = now - info["time"]
+            detail = f"step {info.get('step', '?')} age {age:.0f}s"
+        except Exception:
+            detail = "unreadable (torn write?)"
+        # a finished run's heartbeat ages forever — that's completion, not
+        # death, and must not trigger an auto-restart wrapper
+        status = "done" if done else ("STALLED" if stalled else "ok")
+        print(f"process {proc}: {status} ({detail})")
+        bad += stalled and not done
+
+    if expect is not None:
+        missing = set(range(expect)) - seen
+        for proc in sorted(missing):
+            print(f"process {proc}: MISSING (never wrote a heartbeat)")
+        bad += len(missing)
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("heartbeat_dir", type=Path)
+    parser.add_argument("--timeout", type=float, default=300,
+                        help="seconds without a beat before a host counts as "
+                             "stalled (default 300)")
+    parser.add_argument("--expect", type=int, default=None,
+                        help="expected process count; missing heartbeat files "
+                             "below this index are reported as failures")
+    parser.add_argument("--watch", type=float, default=0,
+                        help="re-scan every S seconds instead of exiting; "
+                             "on ctrl-C/SIGINT exits with the last scan's "
+                             "code")
+    args = parser.parse_args(argv)
+
+    code = 2
+    try:
+        while True:
+            code = scan(args.heartbeat_dir, args.timeout, args.expect)
+            if not args.watch:
+                return code
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

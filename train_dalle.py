@@ -39,6 +39,7 @@ from dalle_pytorch_tpu.parallel import backend as distributed_utils
 from dalle_pytorch_tpu.training import (make_dalle_train_step, make_optimizer,
                                         set_learning_rate)
 from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from dalle_pytorch_tpu.utils.failure import GracefulShutdown, Heartbeat
 from dalle_pytorch_tpu.utils.images import save_image
 from dalle_pytorch_tpu.utils.logging import TrainLogger
 from dalle_pytorch_tpu.utils.schedule import ReduceLROnPlateau
@@ -79,13 +80,23 @@ def parse_args(argv=None):
     parser.add_argument('--profile_dir', type=str, default=None,
                         help='write a jax.profiler trace of steps 10-20 of '
                              'the first epoch to this dir (XProf/TensorBoard)')
+    parser.add_argument('--heartbeat_dir', type=str, default=None,
+                        help='write per-process heartbeat-p{i}.json progress '
+                             'files here for external stall/death monitors')
+    parser.add_argument('--stall_timeout', type=float, default=0,
+                        help='warn on stderr when no step completes for this '
+                             'many seconds (0 disables the in-process '
+                             'watchdog); requires --heartbeat_dir')
     parser.add_argument('--sharded_checkpoints', action='store_true',
                         help='save Orbax sharded checkpoint dirs '
                              '({name}.orbax) with per-host shard IO instead '
                              'of gathering to process 0 (for multi-host '
                              'scale); load sites accept both formats')
     parser = distributed_utils.wrap_arg_parser(parser)
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    if args.stall_timeout and not args.heartbeat_dir:
+        parser.error('--stall_timeout requires --heartbeat_dir')
+    return args
 
 
 def build_vae(args, distr_backend, resume_vae_params=None):
@@ -354,85 +365,122 @@ def main(argv=None):
     lr = sched.lr
     global_step = 0
     profiling_active = False
+    # preemption-safe shutdown + stall detection (SURVEY.md §5.3 — the
+    # reference has neither): SIGTERM/SIGINT checkpoint-and-stop, heartbeat
+    # files for external monitors, in-process hung-step watchdog
+    stopper = GracefulShutdown()
+    heartbeat = (Heartbeat(args.heartbeat_dir,
+                           stall_timeout=args.stall_timeout or None)
+                 if args.heartbeat_dir else None)
+    interrupted = False
     t0 = time.perf_counter()
-    for epoch in range(start_epoch, EPOCHS):
-        epoch_losses = []
-        # one-step-deferred loss logging: materializing the loss each step
-        # would block the host on the device (and the device on the host's
-        # data loading + log IO).  The pmean dispatch is async; float() of
-        # step i's loss happens after step i+1 is already in flight.
-        pending = None  # (iter index, device loss)
+    completed = False
+    try:
+        with stopper:
+            for epoch in range(start_epoch, EPOCHS):
+                epoch_losses = []
+                # one-step-deferred loss logging: materializing the loss each step
+                # would block the host on the device (and the device on the host's
+                # data loading + log IO).  The pmean dispatch is async; float() of
+                # step i's loss happens after step i+1 is already in flight.
+                pending = None  # (iter index, device loss)
 
-        def flush(pending):
-            if pending is None:
-                return
-            it, loss_dev = pending
-            # average_all here, not at dispatch: the multi-host impl blocks
-            # (process_allgather), which would kill the one-step deferral
-            avg_loss = float(distr_backend.average_all(loss_dev))
-            perf = timer.tick(BATCH_SIZE * jax.process_count())
-            epoch_losses.append(avg_loss)
-            logger.step(epoch, it, avg_loss, lr, extra=perf)
+                def flush(pending):
+                    if pending is None:
+                        return
+                    it, loss_dev = pending
+                    # average_all here, not at dispatch: the multi-host impl blocks
+                    # (process_allgather), which would kill the one-step deferral
+                    avg_loss = float(distr_backend.average_all(loss_dev))
+                    perf = timer.tick(BATCH_SIZE * jax.process_count())
+                    epoch_losses.append(avg_loss)
+                    logger.step(epoch, it, avg_loss, lr, extra=perf)
 
-        for i, (text, images) in enumerate(dl):
-            # profiler window: steps 10-20 of the first trained epoch (past
-            # compile + warmup), root process only (ref had no profiler at
-            # all — SURVEY.md §5.1)
-            if args.profile_dir and epoch == start_epoch and \
-                    distr_backend.is_root_worker():
-                window = (min(10, len(dl) - 2), min(20, len(dl) - 1)) \
-                    if len(dl) >= 2 else (None, None)
-                if i == window[0]:
-                    jax.profiler.start_trace(args.profile_dir)
-                    profiling_active = True
-                elif i == window[1] and profiling_active:
-                    jax.block_until_ready(params)
-                    jax.profiler.stop_trace()
-                    profiling_active = False
-                    print(f'profiler trace written to {args.profile_dir}')
-            text_b, images_b = part.shard_batch((text.astype(np.int32), images))
-            rng, step_rng = jax.random.split(rng)
-            params, opt_state, loss = train_step(
-                params, opt_state, vae_params, text_b, images_b, step_rng)
+                for i, (text, images) in enumerate(dl):
+                    # profiler window: steps 10-20 of the first trained epoch (past
+                    # compile + warmup), root process only (ref had no profiler at
+                    # all — SURVEY.md §5.1)
+                    if args.profile_dir and epoch == start_epoch and \
+                            distr_backend.is_root_worker():
+                        window = (min(10, len(dl) - 2), min(20, len(dl) - 1)) \
+                            if len(dl) >= 2 else (None, None)
+                        if i == window[0]:
+                            jax.profiler.start_trace(args.profile_dir)
+                            profiling_active = True
+                        elif i == window[1] and profiling_active:
+                            jax.block_until_ready(params)
+                            jax.profiler.stop_trace()
+                            profiling_active = False
+                            print(f'profiler trace written to {args.profile_dir}')
+                    text_b, images_b = part.shard_batch((text.astype(np.int32), images))
+                    rng, step_rng = jax.random.split(rng)
+                    params, opt_state, loss = train_step(
+                        params, opt_state, vae_params, text_b, images_b, step_rng)
 
-            flush(pending)
-            pending = (i, loss)  # raw device loss; averaged lazily in flush
+                    flush(pending)
+                    pending = (i, loss)  # raw device loss; averaged lazily in flush
 
-            if i % 100 == 0:
-                # periodic sample (ref :396-412): SPMD computation, so every
-                # process runs it; only root writes the image
-                rng, gen_rng = jax.random.split(rng)
-                sample_text = jnp.asarray(text[:1].astype(np.int32))
-                codes = generate_codes(dalle, {'params': params},
-                                       sample_text, gen_rng, filter_thres=0.9)
-                image = host_fetch(decode_images(vae_params, codes)[0])
+                    just_checkpointed = i % 100 == 0
+                    if just_checkpointed:
+                        # periodic sample (ref :396-412): SPMD computation, so every
+                        # process runs it; only root writes the image
+                        rng, gen_rng = jax.random.split(rng)
+                        sample_text = jnp.asarray(text[:1].astype(np.int32))
+                        codes = generate_codes(dalle, {'params': params},
+                                               sample_text, gen_rng, filter_thres=0.9)
+                        image = host_fetch(decode_images(vae_params, codes)[0])
+                        if distr_backend.is_root_worker():
+                            save_image(f'samples/dalle/epoch{epoch}_iter{i}.png', image)
+                            decoded = tokenizer.decode(np.asarray(text[0]))
+                            logger.log({'image_caption': decoded})
+                        save_model('./dalle.pt', epoch)
+                        # wandb.save parity (ref :409); no-op for .orbax dirs
+                        logger.save_file('./dalle.pt')
+                    global_step += 1
+                    if heartbeat is not None:
+                        heartbeat.beat(global_step, epoch=epoch, loss_iter=i)
+                    if stopper.should_stop(distr_backend, step=global_step):
+                        # collective decision: every process exits here together, so
+                        # the collective save below cannot deadlock
+                        flush(pending)
+                        pending = None
+                        if not just_checkpointed:  # ./dalle.pt is already current
+                            save_model('./dalle.pt', epoch)
+                        resume_path = ('./dalle.pt.orbax' if args.sharded_checkpoints
+                                       else './dalle.pt')
+                        if distr_backend.is_root_worker():
+                            print(f'interrupted at epoch {epoch} iter {i}: resume '
+                                  f'checkpoint written to {resume_path} '
+                                  f'(--dalle_path {resume_path} to continue)')
+                        interrupted = True
+                        break
+                flush(pending)
+                if interrupted:
+                    break
+
+                # per-epoch plateau step on the epoch-mean loss (ref :415-416)
+                epoch_loss = float(np.mean(epoch_losses)) if epoch_losses else float('inf')
+                lr = sched.step(epoch_loss)
+                opt_state = set_learning_rate(opt_state, lr)
+                if epoch % 19 == 0:
+                    save_model(f'./sweep1/{logger.run_name}-{epoch}.pt', epoch)
                 if distr_backend.is_root_worker():
-                    save_image(f'samples/dalle/epoch{epoch}_iter{i}.png', image)
-                    decoded = tokenizer.decode(np.asarray(text[0]))
-                    logger.log({'image_caption': decoded})
-                save_model('./dalle.pt', epoch)
-                # wandb.save parity (ref :409); no-op for .orbax dirs
-                logger.save_file('./dalle.pt')
-            global_step += 1
-        flush(pending)
+                    dt = time.perf_counter() - t0
+                    print(f'epoch {epoch} done: loss {epoch_loss:.4f} lr {lr:.2e} '
+                          f'({dt:.1f}s elapsed)')
 
-        # per-epoch plateau step on the epoch-mean loss (ref :415-416)
-        epoch_loss = float(np.mean(epoch_losses)) if epoch_losses else float('inf')
-        lr = sched.step(epoch_loss)
-        opt_state = set_learning_rate(opt_state, lr)
-        if epoch % 19 == 0:
-            save_model(f'./sweep1/{logger.run_name}-{epoch}.pt', epoch)
+            completed = not interrupted
+    finally:
+        if heartbeat is not None:
+            heartbeat.close(done=completed)
+
+    if not interrupted:
+        save_model('./dalle-final.pt', EPOCHS)
         if distr_backend.is_root_worker():
-            dt = time.perf_counter() - t0
-            print(f'epoch {epoch} done: loss {epoch_loss:.4f} lr {lr:.2e} '
-                  f'({dt:.1f}s elapsed)')
-
-    save_model('./dalle-final.pt', EPOCHS)
-    if distr_backend.is_root_worker():
-        # wandb artifact upload parity (ref train_dalle.py:430-437)
-        final_path = ('./dalle-final.pt.orbax' if args.sharded_checkpoints
-                      else './dalle-final.pt')
-        logger.log_artifact(final_path, 'trained-dalle')
+            # wandb artifact upload parity (ref train_dalle.py:430-437)
+            final_path = ('./dalle-final.pt.orbax' if args.sharded_checkpoints
+                          else './dalle-final.pt')
+            logger.log_artifact(final_path, 'trained-dalle')
     logger.finish()
 
 

@@ -32,6 +32,7 @@ from dalle_pytorch_tpu.data.dataset import DataLoader, ImageFolderDataset
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
 from dalle_pytorch_tpu.training import make_optimizer, make_vae_train_step, set_learning_rate
 from dalle_pytorch_tpu.utils.checkpoint import save_checkpoint
+from dalle_pytorch_tpu.utils.failure import GracefulShutdown, Heartbeat
 from dalle_pytorch_tpu.utils.images import save_image_grid
 from dalle_pytorch_tpu.utils.logging import TrainLogger
 from dalle_pytorch_tpu.utils.schedule import ExponentialDecay, GumbelTemperature
@@ -44,8 +45,24 @@ def parse_args(argv=None):
                              'discrete VAE and its codebook')
     parser.add_argument('--image_size', type=int, required=False, default=128,
                         help='image size')
+    parser.add_argument('--resume_path', type=str, default=None,
+                        help='resume from a vae.pt checkpoint (its hparams '
+                             'win over the script constants; optimizer, '
+                             'epoch, lr, and gumbel temperature continue '
+                             'exactly — the reference cannot resume VAE '
+                             'training at all)')
+    parser.add_argument('--heartbeat_dir', type=str, default=None,
+                        help='write per-process heartbeat-p{i}.json progress '
+                             'files here for external stall/death monitors')
+    parser.add_argument('--stall_timeout', type=float, default=0,
+                        help='warn on stderr when no step completes for this '
+                             'many seconds (0 disables the in-process '
+                             'watchdog); requires --heartbeat_dir')
     parser = distributed_utils.wrap_arg_parser(parser)
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    if args.stall_timeout and not args.heartbeat_dir:
+        parser.error('--stall_timeout requires --heartbeat_dir')
+    return args
 
 
 def main(argv=None):
@@ -102,6 +119,40 @@ def main(argv=None):
     distr_backend.initialize()
     distr_backend.check_batch_size(BATCH_SIZE)
 
+    # resume (our §5.3 extension — the reference's train_vae.py cannot
+    # resume): checkpoint hparams win over the script constants and the CLI
+    # --image_size, so this must run before the dataset is built
+    resume_ckpt = None
+    if args.resume_path:
+        from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+        resume_ckpt = jax.tree.map(
+            lambda v: np.asarray(v) if hasattr(v, 'devices') else v,
+            load_checkpoint(args.resume_path))
+        cfg = VAEConfig.from_dict(dict(resume_ckpt['hparams']))
+        IMAGE_SIZE = cfg.image_size
+        vae_params_d = dict(
+            image_size=cfg.image_size, num_layers=cfg.num_layers,
+            num_tokens=cfg.num_tokens, codebook_dim=cfg.codebook_dim,
+            hidden_dim=cfg.hidden_dim,
+            num_resnet_blocks=cfg.num_resnet_blocks,
+        )
+    else:
+        vae_params_d = dict(
+            image_size=IMAGE_SIZE,
+            num_layers=NUM_LAYERS,
+            num_tokens=NUM_TOKENS,
+            codebook_dim=EMB_DIM,
+            hidden_dim=HID_DIM,
+            num_resnet_blocks=NUM_RESNET_BLOCKS,
+        )
+        cfg = VAEConfig(
+            **vae_params_d,
+            smooth_l1_loss=SMOOTH_L1_LOSS,
+            kl_div_loss_weight=KL_LOSS_WEIGHT,
+        )
+    vae = DiscreteVAE(cfg)
+
     ds = ImageFolderDataset(args.image_folder, image_size=IMAGE_SIZE)
     dl = DataLoader(
         ds, BATCH_SIZE, shuffle=True, drop_last=True,
@@ -111,35 +162,38 @@ def main(argv=None):
     if distr_backend.is_root_worker():
         print(f'{len(ds)} images found for training')
 
-    vae_params_d = dict(
-        image_size=IMAGE_SIZE,
-        num_layers=NUM_LAYERS,
-        num_tokens=NUM_TOKENS,
-        codebook_dim=EMB_DIM,
-        hidden_dim=HID_DIM,
-        num_resnet_blocks=NUM_RESNET_BLOCKS,
-    )
-    cfg = VAEConfig(
-        **vae_params_d,
-        smooth_l1_loss=SMOOTH_L1_LOSS,
-        kl_div_loss_weight=KL_LOSS_WEIGHT,
-    )
-    vae = DiscreteVAE(cfg)
-
     rng = jax.random.PRNGKey(0)
     rng, init_rng = jax.random.split(rng)
-    dummy = jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
-    params = jax.jit(lambda r: vae.init({'params': r, 'gumbel': r}, dummy)['params'])(init_rng)
+    if resume_ckpt is not None:
+        params = jax.tree.map(jnp.asarray, resume_ckpt['weights'])
+    else:
+        dummy = jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
+        params = jax.jit(
+            lambda r: vae.init({'params': r, 'gumbel': r}, dummy)['params']
+        )(init_rng)
 
     part = distr_backend.distribute()
     params = part.shard_params(params)
 
     tx = make_optimizer(LEARNING_RATE)
     opt_state = jax.jit(tx.init)(params)
+    if resume_ckpt is not None and 'opt_state' in resume_ckpt:
+        opt_state = jax.tree.map(
+            lambda tmpl, v: (jnp.asarray(v).astype(tmpl.dtype)
+                             if hasattr(tmpl, 'dtype') else v),
+            opt_state,
+            jax.tree.unflatten(jax.tree.structure(opt_state),
+                               jax.tree.leaves(resume_ckpt['opt_state'])))
     train_step = make_vae_train_step(vae, tx)
 
     sched = ExponentialDecay(LEARNING_RATE, LR_DECAY_RATE)
     temp_sched = GumbelTemperature(STARTING_TEMP, TEMP_MIN, ANNEAL_RATE)
+    start_epoch = 0
+    if resume_ckpt is not None:
+        start_epoch = int(resume_ckpt.get('epoch', 0))
+        sched.lr = float(resume_ckpt.get('lr', LEARNING_RATE))
+        temp_sched.value = float(resume_ckpt.get('temperature', STARTING_TEMP))
+        opt_state = set_learning_rate(opt_state, sched.lr)
 
     logger = TrainLogger(
         project='dalle_tpu_train_vae',
@@ -155,67 +209,117 @@ def main(argv=None):
                           method=DiscreteVAE.get_codebook_indices)
         return vae.apply({'params': params}, codes, method=DiscreteVAE.decode), codes
 
-    global_step = 0
-    lr = LEARNING_RATE
-    temp = STARTING_TEMP
-    t_step = time.perf_counter()
-    for epoch in range(EPOCHS):
-        for i, images in enumerate(dl):
-            batch = part.shard_batch(images)
-            rng, step_rng = jax.random.split(rng)
-            params, opt_state, loss, recons = train_step(
-                params, opt_state, batch, step_rng, jnp.asarray(temp, jnp.float32))
-
-            if i % 100 == 0:
-                # periodic probes (ref :187-209): SPMD computations run on
-                # every process; only root writes files
-                k = NUM_IMAGES_SAVE
-                hard, codes = hard_recon(params, batch[:k])
-                host_imgs = host_fetch(batch[:k])
-                host_soft = host_fetch(recons[:k])
-                host_hard = host_fetch(hard)
-                host_codes = host_fetch(codes)
-                weights = host_fetch(params)
-                if distr_backend.is_root_worker():
-                    save_image_grid(f'samples/vae/epoch{epoch}_iter{i}_original.png',
-                                    np.asarray(host_imgs))
-                    save_image_grid(f'samples/vae/epoch{epoch}_iter{i}_soft.png',
-                                    np.asarray(host_soft))
-                    save_image_grid(f'samples/vae/epoch{epoch}_iter{i}_hard.png',
-                                    np.asarray(host_hard))
-                    codes_np = np.asarray(host_codes).reshape(-1)
-                    hist, _ = np.histogram(codes_np, bins=min(512, NUM_TOKENS),
-                                           range=(0, NUM_TOKENS))
-                    logger.log({
-                        'codebook_used_frac': float((hist > 0).mean()),
-                        'temperature': temp,
-                    })
-                    save_checkpoint('vae.pt', {
-                        'hparams': cfg.to_dict(), 'weights': weights,
-                    })
-                    logger.save_file('vae.pt')  # wandb.save parity (ref :221)
-
-                # temperature anneal + lr decay, per-epoch `i % 100` cadence
-                # exactly as the reference (ref :211-217 — it also fires at
-                # i==0 of every epoch, not on a global-step counter)
-                temp = temp_sched.update(global_step)
-                lr = sched.step()
-                opt_state = set_learning_rate(opt_state, lr)
-
-            if i % 10 == 0:
-                avg_loss = float(distr_backend.average_all(loss))
-                dt, t_step = time.perf_counter() - t_step, time.perf_counter()
-                logger.step(epoch, i, avg_loss, lr,
-                            extra={'temperature': temp, 'sec_per_10steps': dt})
-            global_step += 1
-
-    weights = host_fetch(params)
-    if distr_backend.is_root_worker():
-        save_checkpoint('vae-final.pt', {
+    def vae_payload(weights, opt_leaves, epoch):
+        """Checkpoint dict: the reference's ``{'hparams', 'weights'}``
+        (train_vae.py:110-119) plus resume-exactness extras (optimizer,
+        schedules, position) — loaders that only want hparams/weights
+        ignore the rest.  `weights`/`opt_leaves` must already be host
+        arrays: host_fetch is collective (every process participates), so
+        callers fetch *before* any root-only branch."""
+        return {
             'hparams': cfg.to_dict(), 'weights': weights,
-        })
-        # wandb artifact upload parity (ref train_vae.py:241-253)
-        logger.log_artifact('vae-final.pt', 'trained-vae')
+            'opt_state': opt_leaves,
+            'epoch': epoch, 'global_step': global_step,
+            'temperature': temp, 'lr': lr,
+        }
+
+    def save_resume_point(epoch):
+        """Collective fetch + root write of the ``vae.pt`` resume point."""
+        weights = host_fetch(params)
+        opt_leaves = host_fetch(jax.tree.leaves(opt_state))
+        if distr_backend.is_root_worker():
+            save_checkpoint('vae.pt', vae_payload(weights, opt_leaves, epoch))
+
+    global_step = (int(resume_ckpt.get('global_step', 0))
+                   if resume_ckpt is not None else 0)
+    lr = sched.lr
+    temp = temp_sched.value
+    interrupted = False
+    completed = False
+    # preemption-safe shutdown + stall detection (SURVEY.md §5.3)
+    stopper = GracefulShutdown()
+    heartbeat = (Heartbeat(args.heartbeat_dir,
+                           stall_timeout=args.stall_timeout or None)
+                 if args.heartbeat_dir else None)
+    t_step = time.perf_counter()
+    try:
+        with stopper:
+            for epoch in range(start_epoch, EPOCHS):
+                for i, images in enumerate(dl):
+                    batch = part.shard_batch(images)
+                    rng, step_rng = jax.random.split(rng)
+                    params, opt_state, loss, recons = train_step(
+                        params, opt_state, batch, step_rng,
+                        jnp.asarray(temp, jnp.float32))
+
+                    if i % 100 == 0:
+                        # periodic probes (ref :187-209): SPMD computations run
+                        # on every process; only root writes files
+                        k = NUM_IMAGES_SAVE
+                        hard, codes = hard_recon(params, batch[:k])
+                        host_imgs = host_fetch(batch[:k])
+                        host_soft = host_fetch(recons[:k])
+                        host_hard = host_fetch(hard)
+                        host_codes = host_fetch(codes)
+                        weights = host_fetch(params)
+                        opt_leaves = host_fetch(jax.tree.leaves(opt_state))
+                        if distr_backend.is_root_worker():
+                            save_image_grid(f'samples/vae/epoch{epoch}_iter{i}_original.png',
+                                            np.asarray(host_imgs))
+                            save_image_grid(f'samples/vae/epoch{epoch}_iter{i}_soft.png',
+                                            np.asarray(host_soft))
+                            save_image_grid(f'samples/vae/epoch{epoch}_iter{i}_hard.png',
+                                            np.asarray(host_hard))
+                            codes_np = np.asarray(host_codes).reshape(-1)
+                            hist, _ = np.histogram(codes_np, bins=min(512, NUM_TOKENS),
+                                                   range=(0, NUM_TOKENS))
+                            logger.log({
+                                'codebook_used_frac': float((hist > 0).mean()),
+                                'temperature': temp,
+                            })
+                            save_checkpoint('vae.pt',
+                                            vae_payload(weights, opt_leaves, epoch))
+                            logger.save_file('vae.pt')  # wandb.save parity (ref :221)
+
+                        # temperature anneal + lr decay, per-epoch `i % 100`
+                        # cadence exactly as the reference (ref :211-217 — it
+                        # also fires at i==0 of every epoch, not on a
+                        # global-step counter)
+                        temp = temp_sched.update(global_step)
+                        lr = sched.step()
+                        opt_state = set_learning_rate(opt_state, lr)
+
+                    if i % 10 == 0:
+                        avg_loss = float(distr_backend.average_all(loss))
+                        dt, t_step = time.perf_counter() - t_step, time.perf_counter()
+                        logger.step(epoch, i, avg_loss, lr,
+                                    extra={'temperature': temp, 'sec_per_10steps': dt})
+                    global_step += 1
+                    if heartbeat is not None:
+                        heartbeat.beat(global_step, epoch=epoch)
+                    if stopper.should_stop(distr_backend, step=global_step):
+                        save_resume_point(epoch)
+                        if distr_backend.is_root_worker():
+                            print(f'interrupted at epoch {epoch} iter {i}: resume '
+                                  'checkpoint written to vae.pt '
+                                  '(--resume_path vae.pt to continue)')
+                        interrupted = True
+                        break
+                if interrupted:
+                    break
+            completed = not interrupted
+    finally:
+        if heartbeat is not None:
+            heartbeat.close(done=completed)
+
+    if not interrupted:
+        weights = host_fetch(params)
+        opt_leaves = host_fetch(jax.tree.leaves(opt_state))
+        if distr_backend.is_root_worker():
+            save_checkpoint('vae-final.pt',
+                            vae_payload(weights, opt_leaves, EPOCHS))
+            # wandb artifact upload parity (ref train_vae.py:241-253)
+            logger.log_artifact('vae-final.pt', 'trained-vae')
     logger.finish()
 
 
