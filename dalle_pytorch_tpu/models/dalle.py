@@ -224,22 +224,30 @@ class DALLE(nn.Module):
         out = self.transformer(tokens, mask=self._pad_mask_for_bos(mask),
                                deterministic=deterministic)
         logits = self.to_logits_dense(self.final_norm(out.astype(jnp.float32)))
-        logits = jnp.where(self._logits_mask(n)[None], max_neg_value(logits.dtype),
-                           logits)
 
         if not return_loss:
-            return logits
+            return jnp.where(self._logits_mask(n)[None],
+                             max_neg_value(logits.dtype), logits)
 
         assert image_codes is not None, "when training, image codes must be supplied"
-        # labels: next-token over [text[1:], offset image codes] (ref :489-499)
-        labels = jnp.concatenate(
-            [self._remap_pad_tokens(text), image_codes + cfg.total_text_tokens],
-            axis=1)
+        # Phase-sliced cross-entropy: text positions normalize over the text
+        # vocab, image positions over the image vocab.  Identical to the
+        # reference's masked-logits softmax (ref :482-499 — masked entries
+        # are -inf and vanish from the logsumexp) but never materializes the
+        # [b, n, total_tokens] logprobs/mask tensors: at the CUB geometry
+        # that skips ~2 x 1.1 GB of HBM traffic per step.
+        T, V_text = cfg.text_seq_len, cfg.total_text_tokens
 
-        logprobs = jax.nn.log_softmax(logits, axis=-1)
-        token_ll = jnp.take_along_axis(logprobs, labels[:, :, None], axis=-1)[..., 0]
-        loss_text = -token_ll[:, : cfg.text_seq_len].mean()
-        loss_img = -token_ll[:, cfg.text_seq_len:].mean()
+        def phase_ce(phase_logits, labels):
+            lse = jax.nn.logsumexp(phase_logits, axis=-1)
+            ll = jnp.take_along_axis(
+                phase_logits, labels[:, :, None], axis=-1)[..., 0]
+            return (lse - ll).mean()
+
+        # labels: next-token over [text[1:], image codes] (ref :489-499)
+        loss_text = phase_ce(logits[:, :T, :V_text],
+                             self._remap_pad_tokens(text))
+        loss_img = phase_ce(logits[:, T:, V_text:], image_codes)
         return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)
 
     # --- generation (prefill + decode; ref generate_images :370-426) ---
