@@ -108,9 +108,13 @@ class SingleBackend(DistributedBackend):
 
     BACKEND_NAME = "Single"
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, mesh_fsdp: int = 1, mesh_tp: int = 1,
+                 mesh_dcn_dp: int = 1):
         super().__init__()
         self._mesh = mesh
+        self.mesh_fsdp = mesh_fsdp
+        self.mesh_tp = mesh_tp
+        self.mesh_dcn_dp = mesh_dcn_dp
 
     def _initialize(self):
         pass
@@ -128,7 +132,10 @@ class SingleBackend(DistributedBackend):
         pass
 
     def distribute(self, mesh=None, **kwargs) -> Partitioner:
-        mesh = mesh or self._mesh or make_mesh()
+        # a single process can still drive several local chips: honor the
+        # mesh-shape flags here too (dp absorbs the rest)
+        mesh = mesh or self._mesh or make_mesh(
+            fsdp=self.mesh_fsdp, tp=self.mesh_tp, dcn_dp=self.mesh_dcn_dp)
         return Partitioner(mesh=mesh, **kwargs)
 
     def average_all(self, value):
@@ -143,12 +150,16 @@ class GSPMDBackend(DistributedBackend):
     def __init__(self, coordinator_address: Optional[str] = None,
                  num_processes: Optional[int] = None,
                  process_id: Optional[int] = None,
-                 mesh=None):
+                 mesh=None, mesh_fsdp: int = 1, mesh_tp: int = 1,
+                 mesh_dcn_dp: int = 1):
         super().__init__()
         self.coordinator_address = coordinator_address
         self.num_processes = num_processes
         self.process_id = process_id
         self._mesh = mesh
+        self.mesh_fsdp = mesh_fsdp
+        self.mesh_tp = mesh_tp
+        self.mesh_dcn_dp = mesh_dcn_dp
 
     def wrap_arg_parser(self, parser):
         parser.add_argument("--coordinator_address", type=str, default=None,
@@ -203,7 +214,8 @@ class GSPMDBackend(DistributedBackend):
         multihost_utils.sync_global_devices("dalle_pytorch_tpu_barrier")
 
     def distribute(self, mesh=None, **kwargs) -> Partitioner:
-        mesh = mesh or self._mesh or make_mesh()
+        mesh = mesh or self._mesh or make_mesh(
+            fsdp=self.mesh_fsdp, tp=self.mesh_tp, dcn_dp=self.mesh_dcn_dp)
         return Partitioner(mesh=mesh, **kwargs)
 
     def average_all(self, value):
@@ -228,6 +240,16 @@ def wrap_arg_parser(parser):
         "--distributed_backend", "--distr_backend", type=str, default=None,
         help="which distributed backend to use (Single, GSPMD)",
     )
+    # mesh shape is backend-independent (a single process can drive several
+    # local chips); dp absorbs the devices the other axes don't claim
+    parser.add_argument("--mesh_fsdp", type=int, default=1,
+                        help="fsdp (ZeRO-style param/optimizer sharding) "
+                             "ways of the device mesh")
+    parser.add_argument("--mesh_tp", type=int, default=1,
+                        help="tensor-parallel ways of the device mesh")
+    parser.add_argument("--mesh_dcn_dp", type=int, default=1,
+                        help="multi-slice: number of TPU slices joined over "
+                             "DCN, laid out as outer data-parallel groups")
     for b in BACKENDS:
         parser = b().wrap_arg_parser(parser)
     return parser
@@ -244,9 +266,16 @@ def set_backend_from_args(args) -> DistributedBackend:
                     coordinator_address=getattr(args, "coordinator_address", None),
                     num_processes=getattr(args, "num_processes", None),
                     process_id=getattr(args, "process_id", None),
+                    mesh_fsdp=getattr(args, "mesh_fsdp", 1),
+                    mesh_tp=getattr(args, "mesh_tp", 1),
+                    mesh_dcn_dp=getattr(args, "mesh_dcn_dp", 1),
                 )
             else:
-                backend = b_class()
+                backend = b_class(
+                    mesh_fsdp=getattr(args, "mesh_fsdp", 1),
+                    mesh_tp=getattr(args, "mesh_tp", 1),
+                    mesh_dcn_dp=getattr(args, "mesh_dcn_dp", 1),
+                )
             is_distributed = b_class is not SingleBackend
             return backend
     raise ValueError(f"unknown backend {name}; choose from "

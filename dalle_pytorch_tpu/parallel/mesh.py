@@ -44,8 +44,16 @@ DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
 
 
 def make_mesh(dp: Optional[int] = None, fsdp: int = 1, tp: int = 1,
-              devices=None) -> Mesh:
-    """Build a ('dp','fsdp','tp') mesh.  `dp=None` absorbs remaining devices."""
+              devices=None, dcn_dp: int = 1) -> Mesh:
+    """Build a ('dp','fsdp','tp') mesh.  `dp=None` absorbs remaining devices.
+
+    ``dcn_dp > 1`` targets multi-slice topologies (TPU pods joined over the
+    data-center network): the ``dp`` axis is laid out so its outer ``dcn_dp``
+    groups are whole slices — data-parallel gradient ``psum``s hierarchically
+    reduce inside each slice over ICI first and only the per-slice partials
+    cross DCN, while fsdp/tp collectives stay entirely on ICI.  ``dp`` counts
+    the *total* data-parallel ways (ICI ways x dcn_dp).
+    """
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     if dp is None:
@@ -53,6 +61,20 @@ def make_mesh(dp: Optional[int] = None, fsdp: int = 1, tp: int = 1,
         dp = n // (fsdp * tp)
     assert dp * fsdp * tp == n, f"mesh {dp}x{fsdp}x{tp} != {n} devices"
     dev_array = np.asarray(devices).reshape(dp, fsdp, tp)
+    if dcn_dp > 1:
+        assert dp % dcn_dp == 0, f"dp={dp} not divisible by dcn_dp={dcn_dp}"
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        if None not in slice_ids and len(slice_ids) > 1:
+            from jax.experimental import mesh_utils
+
+            # genuine multi-slice topology: let shape/topology mismatches
+            # raise — silently falling back would break the slice-local ICI
+            # reduction guarantee that is the whole point of dcn_dp
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                (dp // dcn_dp, fsdp, tp), (dcn_dp, 1, 1), devices=devices)
+        # else: no slice topology (CPU meshes in tests, single slice) —
+        # row-major order already groups contiguous devices on the outer dp
+        # axis, which is the right fallback layout
     return Mesh(dev_array, ("dp", "fsdp", "tp"))
 
 
