@@ -107,6 +107,32 @@ class DALLEConfig:
         )
 
 
+class PhaseLogits(nn.Module):
+    """The joint-vocab logits head, with a sliced image-phase fast path.
+
+    Parameter tree is identical to the ``nn.Dense(total_tokens)`` it
+    replaces (kernel [dim, total], bias [total]) so existing checkpoints
+    load unchanged.  ``image_only`` multiplies by just the image-vocab
+    columns — every sampled position is an image position (ref logits mask
+    at dalle_pytorch.py:482-484 forces the text half to -inf there), so the
+    decode path can skip half the matmul and never materialize text logits.
+    """
+
+    total_text: int
+    total: int
+
+    @nn.compact
+    def __call__(self, x, image_only: bool = False):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.total), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.total,),
+                          jnp.float32)
+        if image_only:
+            kernel = kernel[:, self.total_text:]
+            bias = bias[self.total_text:]
+        return x @ kernel + bias
+
+
 class AxialPositionalEmbedding(nn.Module):
     """Summed per-row + per-column embeddings over the image raster
     (replaces the external ``axial_positional_embedding`` package the
@@ -154,8 +180,9 @@ class DALLE(nn.Module):
             use_remat=cfg.use_remat, use_pallas=cfg.use_pallas,
             dtype=cfg.dtype, name="transformer")
         self.final_norm = nn.LayerNorm(dtype=jnp.float32, name="final_norm")
-        self.to_logits_dense = nn.Dense(cfg.total_tokens, dtype=jnp.float32,
-                                        name="to_logits_dense")
+        self.to_logits_dense = PhaseLogits(cfg.total_text_tokens,
+                                           cfg.total_tokens,
+                                           name="to_logits_dense")
 
     # --- embedding helpers ---
 
@@ -254,7 +281,8 @@ class DALLE(nn.Module):
 
     def prefill(self, text, prime_codes=None, mask=None):
         """Run the forward over [bos+text (+ primed image codes)], padded to
-        the full static seq_len, returning (last_logits, caches)."""
+        the full static seq_len, returning (last-position image-phase
+        logits [b, num_image_tokens], caches)."""
         cfg = self.cfg
         tokens = self._embed_text(text)
         n_pre = tokens.shape[1]
@@ -269,15 +297,17 @@ class DALLE(nn.Module):
         out, kvs = self.transformer(tokens, mask=self._pad_mask_for_bos(mask),
                                     return_kv=True)
         last = out[:, n_pre - 1 : n_pre]
-        logits = self.to_logits_dense(self.final_norm(last.astype(jnp.float32)))
-        logits = self._mask_image_phase(logits[:, 0])
-        return logits, kvs
+        logits = self.to_logits_dense(self.final_norm(last.astype(jnp.float32)),
+                                      image_only=True)
+        return logits[:, 0], kvs
 
     def decode_step(self, code, caches, index, mask=None):
         """One sampled image code in, next-position logits out.
 
         `code` [b] is the image-vocab token at *input* position `index`
-        (traced); returns ([b, total_tokens] logits, new caches)."""
+        (traced); returns ([b, num_image_tokens] image-phase logits, new
+        caches) — text logits would be -inf here (ref mask :482-484) and
+        are never computed."""
         cfg = self.cfg
         emb = self.image_emb(code[:, None])
         img_index = index - (cfg.text_seq_len + 1)
@@ -286,16 +316,9 @@ class DALLE(nn.Module):
         x = emb.astype(cfg.dtype)
         out, caches = self.transformer.decode_step(
             x, caches, index, mask=self._pad_mask_for_bos(mask))
-        logits = self.to_logits_dense(self.final_norm(out.astype(jnp.float32)))
-        return self._mask_image_phase(logits[:, 0]), caches
-
-    def _mask_image_phase(self, logits):
-        """Suppress text-vocab logits (every sampled position is an image
-        position; ref logits mask at :482-484)."""
-        neg = max_neg_value(logits.dtype)
-        return jnp.where(
-            jnp.arange(self.cfg.total_tokens) < self.cfg.total_text_tokens,
-            neg, logits)
+        logits = self.to_logits_dense(self.final_norm(out.astype(jnp.float32)),
+                                      image_only=True)
+        return logits[:, 0], caches
 
 
 def generate_codes(dalle: DALLE, params, text, rng, *, prime_codes=None,
@@ -316,9 +339,14 @@ def generate_codes(dalle: DALLE, params, text, rng, *, prime_codes=None,
         params, text, prime_codes, mask, method=DALLE.prefill)
 
     def sample(logits, key):
-        filtered = top_k_filter(logits, thres=filter_thres)
+        # logits are image-vocab-only; k still derives from the full joint
+        # vocab (reference semantics — its text entries were -inf and could
+        # never win a slot), and the sampled index IS the image code (the
+        # reference's `- num_text_tokens` offset is pre-applied by slicing)
+        filtered = top_k_filter(logits, thres=filter_thres,
+                                k_vocab=cfg.total_tokens)
         tok = jax.random.categorical(key, filtered / temperature, axis=-1)
-        return (tok - cfg.total_text_tokens).astype(jnp.int32)
+        return tok.astype(jnp.int32)
 
     rng, key0 = jax.random.split(rng)
     first_code = sample(first_logits, key0)

@@ -45,15 +45,24 @@ def l2norm(t: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
     return t / jnp.maximum(jnp.linalg.norm(t, axis=axis, keepdims=True), eps)
 
 
-def top_k_filter(logits: jax.Array, thres: float = 0.5) -> jax.Array:
+def top_k_filter(logits: jax.Array, thres: float = 0.5,
+                 k_vocab: int = None) -> jax.Array:
     """Keep the top `max(int((1-thres)*V), 1)` logits, set the rest to -inf.
 
     Exact semantics of the reference sampler filter
     (`dalle_pytorch.py:44-50`): k is derived from the vocab size, not given
     directly. Static `k` keeps this jit-friendly.
+
+    `k_vocab` overrides the vocab size V used to derive k: the decode path
+    hands in image-vocab-only logits (the text half of the joint vocab is
+    structurally -inf there and is never materialized), but the reference
+    derives k from the FULL joint vocab — since its -inf text entries can
+    never win a top-k slot anyway, deriving k from the full size over the
+    sliced logits selects the identical candidate set.
     """
-    num_logits = logits.shape[-1]
+    num_logits = k_vocab if k_vocab is not None else logits.shape[-1]
     k = max(int((1 - thres) * num_logits), 1)
+    k = min(k, logits.shape[-1])
     vals, _ = jax.lax.top_k(logits, k)
     kth = vals[..., -1:]
     return jnp.where(logits < kth, -jnp.inf, logits)

@@ -142,3 +142,23 @@ def test_grads_flow(small):
     g = jax.grad(loss_fn)(params)
     total = jax.tree.reduce(lambda a, x: a + float(jnp.abs(x).sum()), g, 0.0)
     assert np.isfinite(total) and total > 0
+
+
+def test_top_k_filter_sliced_vs_joint_vocab():
+    """The decode path filters image-vocab-only logits with k derived from
+    the FULL joint vocab (k_vocab) — including the clamp branch where that
+    k exceeds the sliced width. Must select the identical candidate set as
+    the reference-style filter over joint-vocab logits whose text half is
+    -inf (ref dalle_pytorch.py:44-50, :482-484)."""
+    rng = np.random.default_rng(0)
+    v_img, v_total = 12, 40
+    img_logits = rng.normal(size=(3, v_img)).astype(np.float32)
+    joint = np.full((3, v_total), -np.inf, np.float32)
+    joint[:, v_total - v_img:] = img_logits
+
+    for thres in (0.5, 0.8, 0.99):  # k = 20 (clamped to 12), 8, 1
+        ref = np.asarray(top_k_filter(jnp.asarray(joint), thres=thres))
+        fast = np.asarray(top_k_filter(jnp.asarray(img_logits), thres=thres,
+                                       k_vocab=v_total))
+        np.testing.assert_array_equal(ref[:, v_total - v_img:], fast,
+                                      err_msg=f"thres={thres}")
