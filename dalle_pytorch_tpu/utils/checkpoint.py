@@ -83,10 +83,11 @@ def save_checkpoint_sharded(path: str | Path, obj: dict) -> None:
 def load_checkpoint_sharded(path: str | Path, target=None):
     """Restore an Orbax checkpoint directory.  With `target` (a pytree of
     jax.ShapeDtypeStruct with shardings, or arrays), arrays restore directly
-    onto the target shardings — each host reads only its shards.  (The CLI
-    resume path restores target-less and re-shards via host memory — fine
-    single-host; truly-large multi-host resumes should build the param
-    template first and pass it as `target`.)"""
+    onto the target shardings — each host reads only its shards.  The CLI
+    resume path does exactly this via the two-phase ``load_sharded_small``
+    flow (configs first, then arrays straight onto the new run's mesh), so
+    sharded resumes never materialize the full tree in host memory and work
+    across topology changes."""
     import orbax.checkpoint as ocp
 
     path = Path(path).resolve()
@@ -100,6 +101,45 @@ def load_checkpoint_sharded(path: str | Path, target=None):
 def is_sharded_checkpoint(path: str | Path) -> bool:
     """Orbax checkpoints are directories; msgpack checkpoints are files."""
     return Path(path).is_dir()
+
+
+def load_sharded_small(path: str | Path):
+    """Phase 1 of a two-phase elastic resume: restore ONLY the non-array
+    leaves of an Orbax checkpoint (hparams, scheduler scalars, epoch, ...).
+    Array leaves come back as the ``...`` (Ellipsis) placeholder sentinel.
+
+    The caller uses the restored configs to rebuild the model and compute
+    this run's shardings, replaces each placeholder with a matching
+    ``jax.ShapeDtypeStruct`` carrying the new sharding, and passes the tree
+    to ``load_checkpoint_sharded(path, target=...)`` — arrays then restore
+    straight onto the new topology with each host reading only its shards,
+    never materializing the full tree in host memory.
+    """
+    import orbax.checkpoint as ocp
+
+    path = Path(path).resolve()
+    with ocp.PyTreeCheckpointer() as ckptr:
+        meta = ckptr.metadata(path).item_metadata.tree
+
+        def to_item(node):
+            if isinstance(node, dict):
+                return {k: to_item(v) for k, v in node.items()}
+            if isinstance(node, list):
+                return [to_item(v) for v in node]
+            # leaf metadata: >=1-d shapes are real arrays (skip); 0-d /
+            # shapeless leaves (python scalars, strings, optax counts) are
+            # cheap — restore their values.  Typed dummies, not None: a None
+            # item leaf is an empty subtree to orbax and never gets restored
+            shape = getattr(node, "shape", None)
+            if shape:  # non-empty tuple
+                return ocp.PLACEHOLDER
+            dtype = getattr(node, "dtype", None)
+            if dtype is not None:
+                return np.zeros((), dtype)
+            return ""  # string leaf
+
+        item = to_item(meta)
+        return ckptr.restore(path, args=ocp.args.PyTreeRestore(item=item))
 
 
 def migrate_qkv_kernels(tree, dim_head: int = 64):

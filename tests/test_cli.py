@@ -498,3 +498,34 @@ def test_train_vae_resume(trained_vae, tiny_dataset, workdir, monkeypatch):
     assert int(after["epoch"]) == 2
     # resumed from the checkpoint's epoch (1), not from scratch
     assert float(after["lr"]) <= float(before["lr"])
+
+
+def test_sharded_checkpoint_cross_mesh_resume(trained_vae, tiny_dataset,
+                                              tiny_tokenizer_json, tmp_path,
+                                              monkeypatch):
+    """Elastic resume across topologies: a run checkpointed under the
+    default dp-only mesh resumes under dp2 x fsdp2 x tp2 (and vice versa
+    would too) — mesh shape is a per-run choice, not baked into the
+    checkpoint."""
+    monkeypatch.setenv("DALLE_TPU_HPARAMS", json.dumps(DALLE_HPARAMS))
+    monkeypatch.chdir(tmp_path)
+    import train_dalle
+
+    train_dalle.main(["--vae_path", str(trained_vae),
+                      "--image_text_folder", str(tiny_dataset),
+                      "--bpe_path", str(tiny_tokenizer_json),
+                      "--truncate_captions", "--epochs", "1",
+                      "--sharded_checkpoints"])
+    final = tmp_path / "dalle-final.pt.orbax"
+    assert final.is_dir()
+
+    train_dalle.main(["--dalle_path", str(final),
+                      "--image_text_folder", str(tiny_dataset),
+                      "--bpe_path", str(tiny_tokenizer_json),
+                      "--truncate_captions", "--epochs", "2",
+                      "--sharded_checkpoints",
+                      "--mesh_fsdp", "2", "--mesh_tp", "2"])
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    ckpt = load_checkpoint(final)
+    assert int(ckpt["epoch"]) == 2

@@ -184,22 +184,33 @@ def main(argv=None):
 
     # model reconstitution: resume or fresh (ref :116-165)
     resume_ckpt = None
+    resume_sharded = None  # Orbax dir: arrays restore direct-to-device later
     start_epoch = 0
     if exists(args.dalle_path):
+        from dalle_pytorch_tpu.utils.checkpoint import (is_sharded_checkpoint,
+                                                        load_sharded_small)
+
         dalle_path = Path(args.dalle_path)
         assert dalle_path.exists(), 'DALL-E model file does not exist'
-        resume_ckpt = load_checkpoint(dalle_path)
-        # Orbax restores device-placed arrays whose shardings predate this
-        # run's Partitioner; normalize to host numpy so the standard
-        # shard_params/opt-template flow below re-places everything
-        resume_ckpt = jax.tree.map(
-            lambda v: np.asarray(v) if hasattr(v, 'devices') else v,
-            resume_ckpt)
+        if is_sharded_checkpoint(dalle_path):
+            # two-phase elastic resume: configs/scalars now; arrays restore
+            # straight onto this run's shardings after the mesh exists — no
+            # host materialization, works across topology changes
+            resume_sharded = dalle_path
+            resume_ckpt = load_sharded_small(dalle_path)
+        else:
+            resume_ckpt = load_checkpoint(dalle_path)
+            # normalize to host numpy so the standard shard_params /
+            # opt-template flow below re-places everything
+            resume_ckpt = jax.tree.map(
+                lambda v: np.asarray(v) if hasattr(v, 'devices') else v,
+                resume_ckpt)
         resume_vae = resume_ckpt.get('vae_params')
         vae, vae_geom, vae_hparams, vae_weights = build_vae(
             args, distr_backend,
             resume_vae_params=dict(resume_vae) if resume_vae else None)
-        if vae_weights is None and resume_ckpt.get('vae_weights') is not None:
+        if (vae_weights is None and resume_sharded is None
+                and resume_ckpt.get('vae_weights') is not None):
             vae_weights = resume_ckpt['vae_weights']
         dalle_cfg = DALLEConfig.from_dict(dict(resume_ckpt['hparams']), dtype=dtype)
         # the checkpoint's geometry wins over the script constants — a resume
@@ -241,7 +252,7 @@ def main(argv=None):
     dummy_text = jnp.zeros((1, TEXT_SEQ_LEN), jnp.int32)
     dummy_codes = jnp.zeros((1, dalle_cfg.image_seq_len), jnp.int32)
     params = jax.jit(lambda r: dalle.init(r, dummy_text, dummy_codes)['params'])(init_rng)
-    if resume_ckpt is not None:
+    if resume_ckpt is not None and resume_sharded is None:
         from dalle_pytorch_tpu.utils.checkpoint import migrate_qkv_kernels
 
         params = jax.tree.map(
@@ -254,6 +265,19 @@ def main(argv=None):
     is_custom_vae = isinstance(vae, DiscreteVAE)
     if vae_weights is not None:
         vae_params = part.replicate(jax.tree.map(jnp.asarray, vae_weights))
+    elif is_custom_vae and resume_sharded is not None:
+        # shapes only — the real weights restore in phase 2 below; eval_shape
+        # avoids a compile + device compute and, unlike the random-init
+        # branch, consumes no rng split (keeping the post-resume RNG stream
+        # identical between sharded and msgpack checkpoints of the same run)
+        dummy_img = jnp.zeros((1, vae_geom.image_size, vae_geom.image_size, 3))
+        vae_shapes = jax.eval_shape(
+            lambda r: vae.init({'params': r, 'gumbel': r}, dummy_img)['params'],
+            jax.random.PRNGKey(0))
+        vae_params = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                           sharding=part.repl_sharding),
+            vae_shapes)
     elif is_custom_vae:
         # fresh random VAE only makes sense in smoke tests; a real run always
         # has weights, matching the reference's hard requirement of a VAE.
@@ -268,7 +292,58 @@ def main(argv=None):
 
     tx = make_optimizer(LEARNING_RATE, grad_clip_norm=GRAD_CLIP_NORM)
     opt_state = jax.jit(tx.init)(params)
-    if resume_ckpt is not None and 'opt_state' in resume_ckpt:
+    if resume_sharded is not None:
+        # phase 2 of the elastic resume: swap each array placeholder for a
+        # ShapeDtypeStruct carrying THIS run's sharding (params/opt/vae
+        # templates above), then restore — every host reads only its shards,
+        # directly onto the current mesh, whatever topology wrote the ckpt
+        from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint_sharded
+
+        def _sds(arr):
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype,
+                                        sharding=arr.sharding)
+
+        target = dict(resume_ckpt)
+        target['weights'] = jax.tree.map(_sds, params)
+        if 'opt_state' in resume_ckpt:
+            # jit(tx.init) outputs are single-device (XLA only shards them on
+            # the first train step), so they can't serve as sharding
+            # templates; the partitioner path rules apply to the adam
+            # moments too (their paths end in the same param names)
+            opt_template = jax.eval_shape(tx.init, params)
+            opt_sds = [
+                jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s)
+                for t, s in zip(
+                    jax.tree.leaves(opt_template),
+                    jax.tree.leaves(part.param_shardings(opt_template)))]
+            target['opt_state'] = [
+                sds if saved is ... else saved
+                for sds, saved in zip(opt_sds, resume_ckpt['opt_state'])]
+        if 'vae_weights' in resume_ckpt and vae_params is not None:
+            target['vae_weights'] = jax.tree.map(_sds, vae_params)
+        restored = load_checkpoint_sharded(resume_sharded, target=target)
+        params = restored['weights']
+        if 'opt_state' in restored:
+            # big arrays restored onto their templates' shardings pass
+            # through untouched; 0-d leaves (optax count) restored by value
+            # get cast back to the template dtype
+            fitted = [
+                v if (hasattr(v, 'sharding') and getattr(v, 'ndim', 0) > 0)
+                else (jax.device_put(jnp.asarray(v, tmpl.dtype),
+                                     part.repl_sharding)
+                      if hasattr(tmpl, 'dtype') else v)
+                for tmpl, v in zip(jax.tree.leaves(opt_state),
+                                   restored['opt_state'])]
+            opt_state = jax.tree.unflatten(jax.tree.structure(opt_state),
+                                           fitted)
+        if 'vae_weights' in restored and vae_params is not None:
+            vae_params = restored['vae_weights']
+        elif is_custom_vae:
+            assert not any(isinstance(l, jax.ShapeDtypeStruct)
+                           for l in jax.tree.leaves(vae_params)), (
+                f'{resume_sharded} carries no vae_weights but the run needs '
+                'a custom VAE — pass --vae_path for its weights')
+    elif resume_ckpt is not None and 'opt_state' in resume_ckpt:
         def _fit_leaf(tmpl, v):
             if not hasattr(tmpl, 'dtype'):
                 return v
