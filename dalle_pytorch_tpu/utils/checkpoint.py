@@ -94,7 +94,11 @@ def load_checkpoint_sharded(path: str | Path, target=None):
     with ocp.PyTreeCheckpointer() as ckptr:
         if target is None:
             return ckptr.restore(path)
+        # target leaves may be: ShapeDtypeStruct w/ sharding (restore onto
+        # it), a plain value (restored by value), or ocp.PLACEHOLDER (skip
+        # this leaf entirely — it comes back as the Ellipsis sentinel)
         return ckptr.restore(path, args=ocp.args.PyTreeRestore(
+            item=target,
             restore_args=ocp.checkpoint_utils.construct_restore_args(target)))
 
 

@@ -44,9 +44,26 @@ class TrainLogger:
             Path(log_filename).parent.mkdir(parents=True, exist_ok=True)
             self._f = open(log_filename, "a+")
         self.log_filename = log_filename
+        self._shared_name = None
+        if jax.process_count() > 1:
+            # run_name feeds collective checkpoint paths (the sweep saves) —
+            # every process must agree, but only root knows the wandb name:
+            # broadcast it (fixed-size so the collective is shape-static)
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            name = ((self.run.name if self.run is not None
+                     else self._local_name) or "").encode()[:128]
+            buf = np.zeros(128, np.uint8)
+            buf[: len(name)] = np.frombuffer(name, np.uint8)
+            out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+            shared = bytes(out).rstrip(b"\x00").decode(errors="replace")
+            self._shared_name = shared or None
 
     @property
     def run_name(self) -> str:
+        if self._shared_name is not None:
+            return self._shared_name
         if self.run is not None:
             return self.run.name
         return self._local_name or "local-run"

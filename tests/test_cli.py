@@ -529,3 +529,27 @@ def test_sharded_checkpoint_cross_mesh_resume(trained_vae, tiny_dataset,
 
     ckpt = load_checkpoint(final)
     assert int(ckpt["epoch"]) == 2
+
+
+def test_train_vae_sharded_checkpoints_and_resume(tiny_dataset, tmp_path,
+                                                  monkeypatch):
+    """train_vae --sharded_checkpoints writes Orbax dirs and --resume_path
+    accepts them (multi-host symmetric with train_dalle)."""
+    monkeypatch.setenv("DALLE_TPU_HPARAMS", json.dumps(VAE_HPARAMS))
+    monkeypatch.chdir(tmp_path)
+    import train_vae
+
+    train_vae.main(["--image_folder", str(tiny_dataset), "--image_size", "16",
+                    "--sharded_checkpoints"])
+    final = tmp_path / "vae-final.pt.orbax"
+    assert final.is_dir()
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    ckpt = load_checkpoint(final)
+    assert int(ckpt["epoch"]) == 1 and ckpt["hparams"]["num_tokens"] == 32
+
+    monkeypatch.setenv("DALLE_TPU_HPARAMS", json.dumps(dict(VAE_HPARAMS,
+                                                            EPOCHS=2)))
+    train_vae.main(["--image_folder", str(tiny_dataset), "--image_size", "16",
+                    "--resume_path", str(final), "--sharded_checkpoints"])
+    assert int(load_checkpoint(final)["epoch"]) == 2

@@ -251,17 +251,29 @@ def main(argv=None):
     rng, init_rng = jax.random.split(rng)
     dummy_text = jnp.zeros((1, TEXT_SEQ_LEN), jnp.int32)
     dummy_codes = jnp.zeros((1, dalle_cfg.image_seq_len), jnp.int32)
-    params = jax.jit(lambda r: dalle.init(r, dummy_text, dummy_codes)['params'])(init_rng)
-    if resume_ckpt is not None and resume_sharded is None:
-        from dalle_pytorch_tpu.utils.checkpoint import migrate_qkv_kernels
-
-        params = jax.tree.map(
-            jnp.asarray,
-            migrate_qkv_kernels(resume_ckpt['weights'],
-                                dim_head=dalle_cfg.dim_head))
-
     part = distr_backend.distribute()
-    params = part.shard_params(params)
+    if resume_sharded is not None:
+        # no device allocation at all: phase 2 below restores straight onto
+        # ShapeDtypeStruct templates, so an elastic resume never holds a
+        # discarded random init alongside the restored arrays (that 2x peak
+        # would bite exactly when resuming onto less hardware)
+        param_shapes = jax.eval_shape(
+            lambda r: dalle.init(r, dummy_text, dummy_codes)['params'],
+            init_rng)
+        params = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            param_shapes, part.param_shardings(param_shapes))
+    else:
+        params = jax.jit(
+            lambda r: dalle.init(r, dummy_text, dummy_codes)['params'])(init_rng)
+        if resume_ckpt is not None:
+            from dalle_pytorch_tpu.utils.checkpoint import migrate_qkv_kernels
+
+            params = jax.tree.map(
+                jnp.asarray,
+                migrate_qkv_kernels(resume_ckpt['weights'],
+                                    dim_head=dalle_cfg.dim_head))
+        params = part.shard_params(params)
     is_custom_vae = isinstance(vae, DiscreteVAE)
     if vae_weights is not None:
         vae_params = part.replicate(jax.tree.map(jnp.asarray, vae_weights))
@@ -291,7 +303,13 @@ def main(argv=None):
         vae_params = None
 
     tx = make_optimizer(LEARNING_RATE, grad_clip_norm=GRAD_CLIP_NORM)
-    opt_state = jax.jit(tx.init)(params)
+    if resume_sharded is not None:
+        # abstract init: params are ShapeDtypeStructs here, and the real
+        # moments arrive from the checkpoint in phase 2 — allocating zeros
+        # first would only raise the restore's peak memory
+        opt_state = jax.eval_shape(tx.init, params)
+    else:
+        opt_state = jax.jit(tx.init)(params)
     if resume_sharded is not None:
         # phase 2 of the elastic resume: swap each array placeholder for a
         # ShapeDtypeStruct carrying THIS run's sharding (params/opt/vae
@@ -299,28 +317,29 @@ def main(argv=None):
         # directly onto the current mesh, whatever topology wrote the ckpt
         from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint_sharded
 
-        def _sds(arr):
-            return jax.ShapeDtypeStruct(arr.shape, arr.dtype,
-                                        sharding=arr.sharding)
-
         target = dict(resume_ckpt)
-        target['weights'] = jax.tree.map(_sds, params)
+        target['weights'] = params  # already ShapeDtypeStructs w/ shardings
         if 'opt_state' in resume_ckpt:
-            # jit(tx.init) outputs are single-device (XLA only shards them on
-            # the first train step), so they can't serve as sharding
-            # templates; the partitioner path rules apply to the adam
-            # moments too (their paths end in the same param names)
-            opt_template = jax.eval_shape(tx.init, params)
+            # the partitioner path rules apply to the adam moments too
+            # (their paths end in the same param names); scalar leaves
+            # (count, injected lr) fall through to replicated
             opt_sds = [
                 jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s)
                 for t, s in zip(
-                    jax.tree.leaves(opt_template),
-                    jax.tree.leaves(part.param_shardings(opt_template)))]
+                    jax.tree.leaves(opt_state),
+                    jax.tree.leaves(part.param_shardings(opt_state)))]
             target['opt_state'] = [
                 sds if saved is ... else saved
                 for sds, saved in zip(opt_sds, resume_ckpt['opt_state'])]
-        if 'vae_weights' in resume_ckpt and vae_params is not None:
-            target['vae_weights'] = jax.tree.map(_sds, vae_params)
+        # ckpt VAE weights are used only when nothing else supplied them
+        # (--vae_path wins, matching the msgpack path's precedence); when
+        # skipped, their placeholders in `target` make the restore skip
+        # reading them entirely
+        vae_from_ckpt = ('vae_weights' in resume_ckpt and is_custom_vae
+                         and any(isinstance(l, jax.ShapeDtypeStruct)
+                                 for l in jax.tree.leaves(vae_params)))
+        if vae_from_ckpt:
+            target['vae_weights'] = vae_params  # ShapeDtypeStruct templates
         restored = load_checkpoint_sharded(resume_sharded, target=target)
         params = restored['weights']
         if 'opt_state' in restored:
@@ -336,7 +355,10 @@ def main(argv=None):
                                    restored['opt_state'])]
             opt_state = jax.tree.unflatten(jax.tree.structure(opt_state),
                                            fitted)
-        if 'vae_weights' in restored and vae_params is not None:
+        else:
+            # weights-only checkpoint: fall back to fresh optimizer state
+            opt_state = jax.jit(tx.init)(params)
+        if vae_from_ckpt:
             vae_params = restored['vae_weights']
         elif is_custom_vae:
             assert not any(isinstance(l, jax.ShapeDtypeStruct)
@@ -409,8 +431,9 @@ def main(argv=None):
             }
             if is_custom_vae and vae_params is not None:
                 payload['vae_weights'] = vae_params
-            save_checkpoint_sharded(f'{path}.orbax', payload)
-            return
+            path = f'{path}.orbax'
+            save_checkpoint_sharded(path, payload)
+            return path
         # every process participates in the fetch (sharded params span
         # non-addressable devices multi-host); only root writes
         weights = host_fetch(params)
@@ -418,7 +441,7 @@ def main(argv=None):
         vae_weights = (host_fetch(vae_params)
                        if is_custom_vae and vae_params is not None else None)
         if not distr_backend.is_root_worker():
-            return
+            return path
         payload = {
             'hparams': dalle_cfg.to_dict(),
             'vae_params': vae_hparams,  # None for pretrained VAEs (ref :167-172)
@@ -430,6 +453,7 @@ def main(argv=None):
         if vae_weights is not None:
             payload['vae_weights'] = vae_weights
         save_checkpoint(path, payload)
+        return path
 
     from dalle_pytorch_tpu.utils.profiling import StepTimer, dalle_train_flops
 
@@ -519,10 +543,10 @@ def main(argv=None):
                         # the collective save below cannot deadlock
                         flush(pending)
                         pending = None
-                        if not just_checkpointed:  # ./dalle.pt is already current
-                            save_model('./dalle.pt', epoch)
                         resume_path = ('./dalle.pt.orbax' if args.sharded_checkpoints
                                        else './dalle.pt')
+                        if not just_checkpointed:  # ./dalle.pt is already current
+                            resume_path = save_model('./dalle.pt', epoch)
                         if distr_backend.is_root_worker():
                             print(f'interrupted at epoch {epoch} iter {i}: resume '
                                   f'checkpoint written to {resume_path} '
@@ -550,11 +574,9 @@ def main(argv=None):
             heartbeat.close(done=completed)
 
     if not interrupted:
-        save_model('./dalle-final.pt', EPOCHS)
+        final_path = save_model('./dalle-final.pt', EPOCHS)
         if distr_backend.is_root_worker():
             # wandb artifact upload parity (ref train_dalle.py:430-437)
-            final_path = ('./dalle-final.pt.orbax' if args.sharded_checkpoints
-                          else './dalle-final.pt')
             logger.log_artifact(final_path, 'trained-dalle')
     logger.finish()
 

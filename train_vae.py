@@ -58,6 +58,11 @@ def parse_args(argv=None):
                         help='warn on stderr when no step completes for this '
                              'many seconds (0 disables the in-process '
                              'watchdog); requires --heartbeat_dir')
+    parser.add_argument('--sharded_checkpoints', action='store_true',
+                        help='save Orbax sharded checkpoint dirs '
+                             '({name}.orbax) with per-host shard IO instead '
+                             'of gathering to process 0; --resume_path '
+                             'accepts both formats')
     parser = distributed_utils.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
     if args.stall_timeout and not args.heartbeat_dir:
@@ -123,12 +128,21 @@ def main(argv=None):
     # resume): checkpoint hparams win over the script constants and the CLI
     # --image_size, so this must run before the dataset is built
     resume_ckpt = None
+    resume_sharded = None  # Orbax dir: arrays restore direct-to-device later
     if args.resume_path:
-        from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+        from dalle_pytorch_tpu.utils.checkpoint import (is_sharded_checkpoint,
+                                                        load_checkpoint,
+                                                        load_sharded_small)
 
-        resume_ckpt = jax.tree.map(
-            lambda v: np.asarray(v) if hasattr(v, 'devices') else v,
-            load_checkpoint(args.resume_path))
+        if is_sharded_checkpoint(args.resume_path):
+            # two-phase elastic resume (as in train_dalle): configs/scalars
+            # now, arrays straight onto this run's shardings below
+            resume_sharded = Path(args.resume_path)
+            resume_ckpt = load_sharded_small(resume_sharded)
+        else:
+            resume_ckpt = jax.tree.map(
+                lambda v: np.asarray(v) if hasattr(v, 'devices') else v,
+                load_checkpoint(args.resume_path))
         cfg = VAEConfig.from_dict(dict(resume_ckpt['hparams']))
         IMAGE_SIZE = cfg.image_size
         vae_params_d = dict(
@@ -164,26 +178,60 @@ def main(argv=None):
 
     rng = jax.random.PRNGKey(0)
     rng, init_rng = jax.random.split(rng)
-    if resume_ckpt is not None:
-        params = jax.tree.map(jnp.asarray, resume_ckpt['weights'])
-    else:
-        dummy = jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
-        params = jax.jit(
-            lambda r: vae.init({'params': r, 'gumbel': r}, dummy)['params']
-        )(init_rng)
-
     part = distr_backend.distribute()
-    params = part.shard_params(params)
+    dummy = jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
+    if resume_sharded is not None:
+        # templates only: no device allocation before the direct restore
+        shapes = jax.eval_shape(
+            lambda r: vae.init({'params': r, 'gumbel': r}, dummy)['params'],
+            init_rng)
+        params = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            shapes, part.param_shardings(shapes))
+    elif resume_ckpt is not None:
+        params = part.shard_params(
+            jax.tree.map(jnp.asarray, resume_ckpt['weights']))
+    else:
+        params = part.shard_params(jax.jit(
+            lambda r: vae.init({'params': r, 'gumbel': r}, dummy)['params']
+        )(init_rng))
 
     tx = make_optimizer(LEARNING_RATE)
-    opt_state = jax.jit(tx.init)(params)
-    if resume_ckpt is not None and 'opt_state' in resume_ckpt:
-        opt_state = jax.tree.map(
-            lambda tmpl, v: (jnp.asarray(v).astype(tmpl.dtype)
-                             if hasattr(tmpl, 'dtype') else v),
-            opt_state,
-            jax.tree.unflatten(jax.tree.structure(opt_state),
-                               jax.tree.leaves(resume_ckpt['opt_state'])))
+    if resume_sharded is not None:
+        opt_state = jax.eval_shape(tx.init, params)
+        from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint_sharded
+
+        target = dict(resume_ckpt)
+        target['weights'] = params
+        if 'opt_state' in resume_ckpt:
+            opt_sds = [
+                jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s)
+                for t, s in zip(
+                    jax.tree.leaves(opt_state),
+                    jax.tree.leaves(part.param_shardings(opt_state)))]
+            target['opt_state'] = [
+                sds if saved is ... else saved
+                for sds, saved in zip(opt_sds, resume_ckpt['opt_state'])]
+        restored = load_checkpoint_sharded(resume_sharded, target=target)
+        params = restored['weights']
+        fitted = [
+            v if (hasattr(v, 'sharding') and getattr(v, 'ndim', 0) > 0)
+            else (jax.device_put(jnp.asarray(v, tmpl.dtype),
+                                 part.repl_sharding)
+                  if hasattr(tmpl, 'dtype') else v)
+            for tmpl, v in zip(jax.tree.leaves(opt_state),
+                               restored.get('opt_state', []))]
+        opt_state = (jax.tree.unflatten(jax.tree.structure(opt_state), fitted)
+                     if fitted else jax.jit(tx.init)(params))
+    else:
+        opt_state = jax.jit(tx.init)(params)
+        if resume_ckpt is not None and 'opt_state' in resume_ckpt:
+            opt_state = jax.tree.map(
+                lambda tmpl, v: (jnp.asarray(v).astype(tmpl.dtype)
+                                 if hasattr(tmpl, 'dtype') else v),
+                opt_state,
+                jax.tree.unflatten(jax.tree.structure(opt_state),
+                                   jax.tree.leaves(resume_ckpt['opt_state'])))
     train_step = make_vae_train_step(vae, tx)
 
     sched = ExponentialDecay(LEARNING_RATE, LR_DECAY_RATE)
@@ -213,9 +261,10 @@ def main(argv=None):
         """Checkpoint dict: the reference's ``{'hparams', 'weights'}``
         (train_vae.py:110-119) plus resume-exactness extras (optimizer,
         schedules, position) — loaders that only want hparams/weights
-        ignore the rest.  `weights`/`opt_leaves` must already be host
-        arrays: host_fetch is collective (every process participates), so
-        callers fetch *before* any root-only branch."""
+        ignore the rest.  For the msgpack path `weights`/`opt_leaves` must
+        already be host arrays: host_fetch is collective (every process
+        participates), so callers fetch *before* any root-only branch; the
+        Orbax path passes device arrays and shards the IO itself."""
         return {
             'hparams': cfg.to_dict(), 'weights': weights,
             'opt_state': opt_leaves,
@@ -223,12 +272,23 @@ def main(argv=None):
             'temperature': temp, 'lr': lr,
         }
 
-    def save_resume_point(epoch):
-        """Collective fetch + root write of the ``vae.pt`` resume point."""
+    def save_vae_model(path, epoch):
+        """Both checkpoint formats: Orbax sharded dirs ({path}.orbax —
+        per-host shard IO, every process participates collectively) or
+        gathered msgpack (collective fetch, root writes)."""
+        if args.sharded_checkpoints:
+            from dalle_pytorch_tpu.utils.checkpoint import \
+                save_checkpoint_sharded
+
+            path = f'{path}.orbax'
+            save_checkpoint_sharded(
+                path, vae_payload(params, jax.tree.leaves(opt_state), epoch))
+            return path
         weights = host_fetch(params)
         opt_leaves = host_fetch(jax.tree.leaves(opt_state))
         if distr_backend.is_root_worker():
-            save_checkpoint('vae.pt', vae_payload(weights, opt_leaves, epoch))
+            save_checkpoint(path, vae_payload(weights, opt_leaves, epoch))
+        return path
 
     global_step = (int(resume_ckpt.get('global_step', 0))
                    if resume_ckpt is not None else 0)
@@ -261,8 +321,6 @@ def main(argv=None):
                         host_soft = host_fetch(recons[:k])
                         host_hard = host_fetch(hard)
                         host_codes = host_fetch(codes)
-                        weights = host_fetch(params)
-                        opt_leaves = host_fetch(jax.tree.leaves(opt_state))
                         if distr_backend.is_root_worker():
                             save_image_grid(f'samples/vae/epoch{epoch}_iter{i}_original.png',
                                             np.asarray(host_imgs))
@@ -277,9 +335,8 @@ def main(argv=None):
                                 'codebook_used_frac': float((hist > 0).mean()),
                                 'temperature': temp,
                             })
-                            save_checkpoint('vae.pt',
-                                            vae_payload(weights, opt_leaves, epoch))
-                            logger.save_file('vae.pt')  # wandb.save parity (ref :221)
+                        save_vae_model('vae.pt', epoch)
+                        logger.save_file('vae.pt')  # wandb.save parity (ref :221)
 
                         # temperature anneal + lr decay, per-epoch `i % 100`
                         # cadence exactly as the reference (ref :211-217 — it
@@ -298,11 +355,11 @@ def main(argv=None):
                     if heartbeat is not None:
                         heartbeat.beat(global_step, epoch=epoch)
                     if stopper.should_stop(distr_backend, step=global_step):
-                        save_resume_point(epoch)
+                        resume_path = save_vae_model('vae.pt', epoch)
                         if distr_backend.is_root_worker():
                             print(f'interrupted at epoch {epoch} iter {i}: resume '
-                                  'checkpoint written to vae.pt '
-                                  '(--resume_path vae.pt to continue)')
+                                  f'checkpoint written to {resume_path} '
+                                  f'(--resume_path {resume_path} to continue)')
                         interrupted = True
                         break
                 if interrupted:
@@ -313,13 +370,10 @@ def main(argv=None):
             heartbeat.close(done=completed)
 
     if not interrupted:
-        weights = host_fetch(params)
-        opt_leaves = host_fetch(jax.tree.leaves(opt_state))
+        final_path = save_vae_model('vae-final.pt', EPOCHS)
         if distr_backend.is_root_worker():
-            save_checkpoint('vae-final.pt',
-                            vae_payload(weights, opt_leaves, EPOCHS))
             # wandb artifact upload parity (ref train_vae.py:241-253)
-            logger.log_artifact('vae-final.pt', 'trained-vae')
+            logger.log_artifact(final_path, 'trained-vae')
     logger.finish()
 
 
