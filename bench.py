@@ -110,21 +110,73 @@ def run_generate(batch: int = 8):
     return batch * cfg.image_seq_len / dt, dt
 
 
-def _run_with_retry(attempts: int = 3, wait_s: float = 60.0):
-    """The remote TPU tunnel occasionally 500s or drops for a while; a
-    transient failure should not zero the round's benchmark.  Measurement
-    policy (declared from the first recorded round so every round compares
-    like-for-like): up to `attempts` tries, report the best of the first
-    two successes — the chip is shared and single draws under-report device
-    capability.  The policy is echoed on stderr next to the result."""
+def _bounded_call(fn):
+    """Run ``fn`` in a daemon worker thread, returning (thread, result box).
+    A dead tunnel hangs inside blocking device calls that no exception ever
+    exits, so deadline enforcement has to live outside the call."""
+    import threading
+
+    box = {}
+
+    def work():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001
+            box["error"] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    return t, box
+
+
+def _run_with_retry(attempts: int = None, wait_s: float = None):
+    """The remote TPU tunnel occasionally 500s or drops — sometimes for an
+    hour at a stretch; a transient outage should not zero the round's
+    benchmark.  Measurement policy (declared from the first recorded round
+    so every round compares like-for-like): up to `attempts` tries (spaced
+    `wait_s` apart, both overridable via BENCH_ATTEMPTS / BENCH_WAIT_S),
+    report the best of the first two successes — the chip is shared and
+    single draws under-report device capability.  The policy is echoed on
+    stderr next to the result.  Each attempt is also bounded by a watchdog
+    (BENCH_ATTEMPT_TIMEOUT_S, default 900): a hung tunnel dispatch
+    otherwise blocks forever and the driver would record nothing at all."""
+    import os
     import sys
+
+    attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", attempts or 5)))
+    wait_s = float(os.environ.get("BENCH_WAIT_S", wait_s or 120.0))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 900))
+
+    pending = None  # an abandoned (timed-out but alive) attempt thread
+
+    def run_bounded():
+        nonlocal pending
+        if pending is not None and pending.is_alive():
+            # never run two measurements on the chip at once — a stalled
+            # previous attempt would skew this one and both would be wrong
+            pending.join(wait_s)
+            if pending.is_alive():
+                raise TimeoutError(
+                    "previous bench attempt still wedged in a device call; "
+                    "refusing to measure concurrently")
+        pending = None
+        t, box = _bounded_call(lambda: run(use_pallas=False))
+        t.join(attempt_timeout)
+        if t.is_alive():
+            pending = t
+            raise TimeoutError(
+                f"bench attempt still running after {attempt_timeout:.0f}s "
+                "(tunnel hang?)")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
 
     best = None
     successes = 0
     last_err = None
     for attempt in range(attempts):
         try:
-            result = run(use_pallas=False)
+            result = run_bounded()
             successes += 1
             if best is None or result[0] > best[0]:
                 best = result
@@ -159,7 +211,17 @@ def main():
     print(f"achieved {flops/1e12:.2f} TFLOP/s (dense-equivalent), "
           f"MFU {flops/device_peak_flops():.2%}", file=sys.stderr)
     try:
-        tok_per_sec, _ = run_generate()
+        # same hang watchdog as training: a wedged tunnel here would block
+        # the stdout JSON line the driver is waiting on
+        import os as _os
+
+        t, box = _bounded_call(run_generate)
+        t.join(float(_os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 900)))
+        if t.is_alive():
+            raise TimeoutError("generation bench hung")
+        if "error" in box:
+            raise box["error"]
+        tok_per_sec, _ = box["result"]
         print(f"generation: {tok_per_sec:.1f} image-tokens/sec "
               "(KV-cache sampler)", file=sys.stderr)
     except Exception as e:  # generation bench is informational only
