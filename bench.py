@@ -42,11 +42,20 @@ def cub200_config(use_pallas: bool = False):
     )
 
 
-def run(use_pallas: bool = False, steps: int = STEPS):
+def make_train_measure(steps: int = STEPS, **overrides):
+    """Build + compile the scan-of-steps train loop once.  Returns
+    ``(measure, cfg, batch)`` where each ``measure()`` call times one scan
+    and returns ``(images_per_sec, dt)`` — shared by run() and
+    tools/perf_ab.py so the measured loop can never drift between them.
+    ``overrides`` replace DALLEConfig fields (e.g. use_pallas=True)."""
+    import dataclasses
+
     from dalle_pytorch_tpu import DALLE
     from dalle_pytorch_tpu.training import make_dalle_train_step, make_optimizer
 
-    cfg = cub200_config(use_pallas=use_pallas)
+    cfg = cub200_config()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
     model = DALLE(cfg)
     batch = 16
 
@@ -73,21 +82,29 @@ def run(use_pallas: bool = False, steps: int = STEPS):
         return params, opt_state, losses[-1]
 
     # warmup: compiles the scan at the measured length
-    p, o, loss = run_steps(params, opt_state, rng, steps)
+    _, _, loss = run_steps(params, opt_state, rng, steps)
     assert jnp.isfinite(jax.device_get(loss)), "non-finite warmup loss"
 
-    t0 = time.perf_counter()
-    p, o, loss = run_steps(p, o, rng, steps)
-    final = float(jax.device_get(loss))  # forces the whole scan to finish
-    dt = time.perf_counter() - t0
-    assert jnp.isfinite(final), "non-finite bench loss"
+    def measure():
+        t0 = time.perf_counter()
+        _, _, loss = run_steps(params, opt_state, rng, steps)
+        final = float(jax.device_get(loss))  # forces the whole scan to finish
+        dt = time.perf_counter() - t0
+        assert jnp.isfinite(final), "non-finite bench loss"
+        return batch * steps / dt, dt
 
-    return batch * steps / dt, dt, cfg, batch
+    return measure, cfg, batch
 
 
-def run_generate(batch: int = 8):
-    """AR image-token sampling throughput (BASELINE.md's second north-star:
-    'AR image-tokens/sec (generate)') via the jitted KV-cache sampler."""
+def run(use_pallas: bool = False, steps: int = STEPS):
+    measure, cfg, batch = make_train_measure(steps, use_pallas=use_pallas)
+    images_per_sec, dt = measure()
+    return images_per_sec, dt, cfg, batch
+
+
+def make_gen_measure(batch: int = 8):
+    """Compile the jitted KV-cache sampler once; each ``measure()`` call
+    returns ``(image_tokens_per_sec, dt)``."""
     from dalle_pytorch_tpu import DALLE
     from dalle_pytorch_tpu.models.dalle import generate_codes
 
@@ -101,13 +118,22 @@ def run_generate(batch: int = 8):
 
     gen = jax.jit(lambda p, t, k: generate_codes(model, {"params": p}, t, k,
                                                  filter_thres=0.9))
-    codes = gen(params, text, rng)  # compile
-    _ = jax.device_get(codes)
-    t0 = time.perf_counter()
-    codes = gen(params, text, jax.random.PRNGKey(1))
-    _ = jax.device_get(codes)
-    dt = time.perf_counter() - t0
-    return batch * cfg.image_seq_len / dt, dt
+    _ = jax.device_get(gen(params, text, rng))  # compile
+
+    def measure():
+        t0 = time.perf_counter()
+        codes = gen(params, text, jax.random.PRNGKey(1))
+        _ = jax.device_get(codes)
+        dt = time.perf_counter() - t0
+        return batch * cfg.image_seq_len / dt, dt
+
+    return measure
+
+
+def run_generate(batch: int = 8):
+    """AR image-token sampling throughput (BASELINE.md's second north-star:
+    'AR image-tokens/sec (generate)')."""
+    return make_gen_measure(batch)()
 
 
 def _bounded_call(fn):

@@ -97,3 +97,42 @@ def test_retry_env_attempts_clamped(monkeypatch):
     monkeypatch.setenv("BENCH_ATTEMPTS", "0")
     monkeypatch.setenv("BENCH_WAIT_S", "0")
     assert bench._run_with_retry()[0] == 10.0
+
+
+def test_perf_ab_tool(monkeypatch, capsys):
+    """tools/perf_ab.py runs interleaved variants end-to-end (tiny config)."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).resolve().parent.parent
+                                    / "tools"))
+    import jax.numpy as jnp
+
+    import perf_ab
+    from dalle_pytorch_tpu import DALLEConfig
+
+    def tiny_config(use_pallas=False):
+        return DALLEConfig(
+            dim=32, num_text_tokens=64, text_seq_len=8, depth=2, heads=2,
+            dim_head=16, attn_types=("full", "axial_row"),
+            num_image_tokens=32, image_size=32, image_fmap_size=4,
+            use_pallas=use_pallas, dtype=jnp.float32)
+
+    monkeypatch.setattr(bench, "cub200_config", tiny_config)
+    assert perf_ab.main(["--list"]) == 0
+    assert perf_ab.main(["baseline", "full-attn", "--reps", "2",
+                         "--steps", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "medians:" in out and "baseline" in out and "full-attn" in out
+
+
+def test_perf_ab_rejects_bad_args(monkeypatch, capsys):
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).resolve().parent.parent
+                                    / "tools"))
+    import perf_ab
+
+    with pytest.raises(SystemExit):  # typo'd variant -> usage error, fast
+        perf_ab.main(["palas"])
+    with pytest.raises(SystemExit):
+        perf_ab.main(["baseline", "--reps", "0"])

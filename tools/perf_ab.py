@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Interleaved A/B perf experiments on the CUB-200 DALLE train step.
+
+The bench chip is shared and its throughput drifts minutes apart, so single
+draws are meaningless; this tool compiles every requested variant once,
+then measures them round-robin for `--reps` rounds and reports per-variant
+medians — ambient drift hits all variants roughly equally within a round.
+
+Usage:
+    python tools/perf_ab.py baseline pallas --reps 3 --steps 30
+    python tools/perf_ab.py --list
+
+Variants are train-step configs (see VARIANTS); `gen` measures the KV-cache
+sampler instead. The measured loops are bench.py's own
+(`make_train_measure` / `make_gen_measure`), so this tool can never drift
+from the driver-facing benchmark.
+"""
+from __future__ import annotations
+
+import argparse
+import statistics
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax.numpy as jnp  # noqa: E402
+
+VARIANTS = {
+    "baseline": {},
+    "pallas": dict(use_pallas=True),
+    "fp32": dict(dtype=jnp.float32),
+    "full-attn": dict(attn_types=("full",)),
+    "reversible": dict(reversible=True),
+    "remat": dict(use_remat=True),
+}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("variants", nargs="*", default=[],
+                        help=f"from: {', '.join(VARIANTS)} , or 'gen'")
+    parser.add_argument("--reps", type=int, default=3,
+                        help="interleaved measurement rounds (default 3)")
+    parser.add_argument("--steps", type=int, default=30,
+                        help="train steps per measurement (default 30)")
+    parser.add_argument("--list", action="store_true")
+    args = parser.parse_args(argv)
+    if args.list or not args.variants:
+        print("variants:", ", ".join(list(VARIANTS) + ["gen"]))
+        return 0
+    if args.reps < 1:
+        parser.error("--reps must be >= 1")
+    unknown = [v for v in args.variants if v != "gen" and v not in VARIANTS]
+    if unknown:
+        parser.error(f"unknown variant(s) {unknown}; choose from "
+                     f"{list(VARIANTS) + ['gen']}")
+
+    import bench
+
+    measures = {}
+    for name in args.variants:
+        print(f"compiling {name}...", file=sys.stderr, flush=True)
+        if name == "gen":
+            measures[name] = bench.make_gen_measure()
+        else:
+            measures[name] = bench.make_train_measure(
+                args.steps, **VARIANTS[name])[0]
+
+    results = {name: [] for name in measures}
+    for rep in range(args.reps):
+        for name, measure in measures.items():  # interleaved round-robin
+            v, _ = measure()
+            results[name].append(v)
+            unit = "tok/s" if name == "gen" else "img/s"
+            print(f"rep{rep} {name:12s} {v:9.2f} {unit}", flush=True)
+
+    print("\nmedians:")
+    for name, vals in results.items():
+        unit = "tok/s" if name == "gen" else "img/s"
+        print(f"  {name:12s} {statistics.median(vals):9.2f} {unit}  "
+              f"(spread {min(vals):.2f}-{max(vals):.2f})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
