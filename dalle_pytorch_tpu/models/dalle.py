@@ -63,6 +63,10 @@ class DALLEConfig:
     # TPU-native extras
     use_remat: bool = False
     use_pallas: bool = False   # Pallas flash/block-sparse attention
+    logits_bf16: bool = False  # head matmul in bf16 (f32 accumulate)
+    onehot_embed: bool = False  # loss-path embeds via one-hot matmul (MXU
+    #                             backward instead of scatter-add); inference
+    #                             forwards keep the gather
     dtype: Any = jnp.float32
 
     @property
@@ -116,10 +120,15 @@ class PhaseLogits(nn.Module):
     columns — every sampled position is an image position (ref logits mask
     at dalle_pytorch.py:482-484 forces the text half to -inf there), so the
     decode path can skip half the matmul and never materialize text logits.
+
+    ``bf16_matmul`` runs the matmul with bf16 inputs and f32 accumulation
+    (the MXU's native mode, ~4x the f32 rate); params and the returned
+    logits stay f32.
     """
 
     total_text: int
     total: int
+    bf16_matmul: bool = False
 
     @nn.compact
     def __call__(self, x, image_only: bool = False):
@@ -130,6 +139,10 @@ class PhaseLogits(nn.Module):
         if image_only:
             kernel = kernel[:, self.total_text:]
             bias = bias[self.total_text:]
+        if self.bf16_matmul:
+            return jnp.dot(x.astype(jnp.bfloat16),
+                           kernel.astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32) + bias
         return x @ kernel + bias
 
 
@@ -182,6 +195,7 @@ class DALLE(nn.Module):
         self.final_norm = nn.LayerNorm(dtype=jnp.float32, name="final_norm")
         self.to_logits_dense = PhaseLogits(cfg.total_text_tokens,
                                            cfg.total_tokens,
+                                           bf16_matmul=cfg.logits_bf16,
                                            name="to_logits_dense")
 
     # --- embedding helpers ---
@@ -194,19 +208,32 @@ class DALLE(nn.Module):
             cfg.total_text_tokens - cfg.text_seq_len)
         return jnp.where(text == 0, text_range, text)
 
-    def _embed_text(self, text):
+    def _lookup(self, table: nn.Embed, ids, onehot: bool):
+        """Token lookup; with ``onehot`` the gather becomes a one-hot matmul
+        whose transpose (the embedding gradient) is a plain matmul on the
+        MXU instead of a scatter-add.  HIGHEST precision keeps the forward
+        bit-exact with the gather — TPU's default f32 matmul precision would
+        round the selected rows through bf16."""
+        if onehot:
+            oh = jax.nn.one_hot(ids, table.num_embeddings,
+                                dtype=table.embedding.dtype)
+            return jnp.dot(oh, table.embedding,
+                           precision=jax.lax.Precision.HIGHEST)
+        return table(ids)
+
+    def _embed_text(self, text, onehot: bool = False):
         """Unique-pad remap + <bos> + token/pos embeddings (ref :440-448)."""
         cfg = self.cfg
         assert text.shape[-1] == cfg.text_seq_len, (
             f"text length {text.shape[-1]} != text_seq_len {cfg.text_seq_len}"
         )
         text = jnp.pad(self._remap_pad_tokens(text), ((0, 0), (1, 0)))  # <bos> id 0
-        tokens = self.text_emb(text)
+        tokens = self._lookup(self.text_emb, text, onehot)
         tokens = tokens + self.text_pos_emb(jnp.arange(text.shape[1]))
         return tokens.astype(cfg.dtype)
 
-    def _embed_image_codes(self, codes):
-        emb = self.image_emb(codes)
+    def _embed_image_codes(self, codes, onehot: bool = False):
+        emb = self._lookup(self.image_emb, codes, onehot)
         emb = emb + self.image_pos_emb(codes.shape[1])
         return emb.astype(self.cfg.dtype)
 
@@ -237,10 +264,13 @@ class DALLE(nn.Module):
     def __call__(self, text, image_codes=None, mask=None, return_loss: bool = False,
                  deterministic: bool = True):
         cfg = self.cfg
-        tokens = self._embed_text(text)
+        # one-hot embeds only pay off through their backward — inference
+        # forwards (return_loss=False, prefill, decode) keep the gather
+        onehot = cfg.onehot_embed and return_loss
+        tokens = self._embed_text(text, onehot)
 
         if image_codes is not None and image_codes.shape[1] > 0:
-            image_emb = self._embed_image_codes(image_codes)
+            image_emb = self._embed_image_codes(image_codes, onehot)
             tokens = jnp.concatenate([tokens, image_emb], axis=1)
 
         # drop the final token when the sequence overflows (ref :473-475)

@@ -34,6 +34,9 @@ VARIANTS = {
     "full-attn": dict(attn_types=("full",)),
     "reversible": dict(reversible=True),
     "remat": dict(use_remat=True),
+    "bf16-logits": dict(logits_bf16=True),
+    "onehot-embed": dict(onehot_embed=True),
+    "bf16-logits+onehot": dict(logits_bf16=True, onehot_embed=True),
 }
 
 
