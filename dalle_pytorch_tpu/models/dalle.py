@@ -63,6 +63,8 @@ class DALLEConfig:
     # TPU-native extras
     use_remat: bool = False
     use_pallas: bool = False   # Pallas flash/block-sparse attention
+    pallas_block_q: int = 128  # Pallas tile sizes (perf_ab sweeps these)
+    pallas_block_k: int = 128
     logits_bf16: bool = False  # head matmul in bf16 (f32 accumulate)
     onehot_embed: bool = False  # loss-path embeds via one-hot matmul (MXU
     #                             backward instead of scatter-add); inference
@@ -191,6 +193,8 @@ class DALLE(nn.Module):
             attn_types=tuple(attn_types), image_fmap_size=cfg.image_fmap_size,
             text_len=cfg.text_seq_len + 1, reversible=cfg.reversible,
             use_remat=cfg.use_remat, use_pallas=cfg.use_pallas,
+            pallas_block_q=cfg.pallas_block_q,
+            pallas_block_k=cfg.pallas_block_k,
             dtype=cfg.dtype, name="transformer")
         self.final_norm = nn.LayerNorm(dtype=jnp.float32, name="final_norm")
         self.to_logits_dense = PhaseLogits(cfg.total_text_tokens,

@@ -230,6 +230,8 @@ class MultiHeadAttention(nn.Module):
     dim_head: int = 64
     dropout: float = 0.0
     use_pallas: bool = False
+    pallas_block_q: int = 128   # Pallas tile sizes; sweep via
+    pallas_block_k: int = 128   # tools/perf_ab.py pallas-b* variants
     ring_axis: Optional[str] = None  # sequence-parallel axis (inside shard_map)
     sp_impl: str = "ring"            # 'ring' (k/v rotation) | 'ulysses' (all-to-all)
     dtype: Any = jnp.float32
@@ -286,9 +288,13 @@ class MultiHeadAttention(nn.Module):
 
             # the kernels lower through Mosaic only on TPU; anywhere else
             # (CPU tests, GPU) fall back to the interpreter
+            assert self.pallas_block_q >= 1 and self.pallas_block_k >= 1, (
+                f"invalid Pallas block sizes {self.pallas_block_q}x"
+                f"{self.pallas_block_k}")
             out = flash_pattern_attention(
                 q, k, v, self.pattern,
                 key_pad_bias=self._key_pad_bias(mask, n),
+                block_q=self.pallas_block_q, block_k=self.pallas_block_k,
                 interpret=jax.default_backend() != "tpu")
         else:
             scale = self.dim_head ** -0.5

@@ -47,6 +47,8 @@ class AttnBlock(nn.Module):
     dim_head: int = 64
     dropout: float = 0.0
     use_pallas: bool = False
+    pallas_block_q: int = 128
+    pallas_block_k: int = 128
     ring_axis: Optional[str] = None
     sp_impl: str = "ring"
     dtype: Any = jnp.float32
@@ -56,7 +58,10 @@ class AttnBlock(nn.Module):
         self.attn = MultiHeadAttention(
             pattern=self.pattern, dim=self.dim, heads=self.heads,
             dim_head=self.dim_head, dropout=self.dropout,
-            use_pallas=self.use_pallas, ring_axis=self.ring_axis,
+            use_pallas=self.use_pallas,
+            pallas_block_q=self.pallas_block_q,
+            pallas_block_k=self.pallas_block_k,
+            ring_axis=self.ring_axis,
             sp_impl=self.sp_impl, dtype=self.dtype,
             name="attn",
         )
@@ -172,6 +177,8 @@ class Transformer(nn.Module):
     reversible_naive: bool = False  # test hook: plain-autodiff two-stream
     use_remat: bool = False
     use_pallas: bool = False   # Pallas flash/block-sparse attention kernels
+    pallas_block_q: int = 128
+    pallas_block_k: int = 128
     ring_axis: Optional[str] = None  # sequence-parallel axis (inside shard_map)
     sp_impl: str = "ring"            # 'ring' | 'ulysses' (all-to-all)
     ff_experts: int = 0        # >1: MoE feed-forward with this many experts
@@ -202,6 +209,8 @@ class Transformer(nn.Module):
                 pattern=pattern, dim=self.dim, layer_index=ind + 1,
                 heads=self.heads, dim_head=self.dim_head,
                 dropout=self.attn_dropout, use_pallas=self.use_pallas,
+                pallas_block_q=self.pallas_block_q,
+                pallas_block_k=self.pallas_block_k,
                 ring_axis=self.ring_axis, sp_impl=self.sp_impl,
                 dtype=self.dtype,
                 name=f"layers_{ind}_attn",

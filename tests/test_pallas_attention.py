@@ -155,3 +155,38 @@ def test_block_sparsity_actually_skips():
     assert axial.sum() <= full.sum()
     # causal: upper-triangle blocks (beyond diagonal) are skipped
     assert full[0, 1] == 0 and full[0, 2] == 0
+
+
+def test_block_size_config_override(monkeypatch):
+    """pallas_block_q/k thread from the layer config to the kernel launch
+    (perf_ab's pallas-b* variants sweep them) and results stay equivalent."""
+    import dalle_pytorch_tpu.ops.attention_pallas as ap
+    from dalle_pytorch_tpu.ops.attention import AttnPattern, MultiHeadAttention
+
+    seen = {}
+    orig = ap.flash_pattern_attention
+
+    def spy(*args, **kwargs):
+        seen.update(block_q=kwargs.get("block_q"),
+                    block_k=kwargs.get("block_k"))
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(ap, "flash_pattern_attention", spy)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    pattern = AttnPattern(variant="full", seq_len=24, text_len=8, fmap=4)
+    attn = MultiHeadAttention(pattern=pattern, dim=32, heads=2, dim_head=16,
+                              use_pallas=True, pallas_block_q=64,
+                              pallas_block_k=64)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 24, 32))
+    params = attn.init(jax.random.PRNGKey(1), x)
+    out = attn.apply(params, x)
+    assert seen == {"block_q": 64, "block_k": 64}
+
+    dense = MultiHeadAttention(pattern=pattern, dim=32, heads=2, dim_head=16)
+    ref = dense.apply(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

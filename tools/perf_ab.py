@@ -30,6 +30,9 @@ import jax.numpy as jnp  # noqa: E402
 VARIANTS = {
     "baseline": {},
     "pallas": dict(use_pallas=True),
+    "pallas-b64": dict(use_pallas=True, pallas_block_q=64, pallas_block_k=64),
+    "pallas-b256": dict(use_pallas=True, pallas_block_q=256,
+                        pallas_block_k=256),
     "fp32": dict(dtype=jnp.float32),
     "full-attn": dict(attn_types=("full",)),
     "reversible": dict(reversible=True),
