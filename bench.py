@@ -42,6 +42,27 @@ def cub200_config(use_pallas: bool = False):
     )
 
 
+def _scan_measure(run_steps, params, opt_state, rng, steps, items_per_step):
+    """Shared warmup + timing harness for the scan-of-steps benchmarks: one
+    compile, then each measure() times a scan and syncs on the final loss.
+    All bench loops go through here so their measured semantics can't drift
+    (and the device sync is a plain statement — never inside an assert,
+    which python -O would strip, leaving only async dispatch time)."""
+    _, _, loss = run_steps(params, opt_state, rng, steps)
+    warm = float(jax.device_get(loss))
+    assert jnp.isfinite(warm), "non-finite warmup loss"
+
+    def measure():
+        t0 = time.perf_counter()
+        _, _, loss = run_steps(params, opt_state, rng, steps)
+        final = float(jax.device_get(loss))  # forces the whole scan to finish
+        dt = time.perf_counter() - t0
+        assert jnp.isfinite(final), "non-finite bench loss"
+        return items_per_step * steps / dt, dt
+
+    return measure
+
+
 def make_train_measure(steps: int = STEPS, **overrides):
     """Build + compile the scan-of-steps train loop once.  Returns
     ``(measure, cfg, batch)`` where each ``measure()`` call times one scan
@@ -81,18 +102,7 @@ def make_train_measure(steps: int = STEPS, **overrides):
             body, (params, opt_state, rng), None, length=n_steps)
         return params, opt_state, losses[-1]
 
-    # warmup: compiles the scan at the measured length
-    _, _, loss = run_steps(params, opt_state, rng, steps)
-    assert jnp.isfinite(jax.device_get(loss)), "non-finite warmup loss"
-
-    def measure():
-        t0 = time.perf_counter()
-        _, _, loss = run_steps(params, opt_state, rng, steps)
-        final = float(jax.device_get(loss))  # forces the whole scan to finish
-        dt = time.perf_counter() - t0
-        assert jnp.isfinite(final), "non-finite bench loss"
-        return batch * steps / dt, dt
-
+    measure = _scan_measure(run_steps, params, opt_state, rng, steps, batch)
     return measure, cfg, batch
 
 
@@ -100,6 +110,47 @@ def run(use_pallas: bool = False, steps: int = STEPS):
     measure, cfg, batch = make_train_measure(steps, use_pallas=use_pallas)
     images_per_sec, dt = measure()
     return images_per_sec, dt, cfg, batch
+
+
+def vae128_config():
+    """The reference's stage-1 trainer config at 128px (ref train_vae.py:
+    42-59): 8192 tokens, 2 conv layers, 2 resblocks, emb 512, hid 256 —
+    BASELINE.json config 1."""
+    from dalle_pytorch_tpu import VAEConfig
+
+    return VAEConfig(image_size=128, num_tokens=8192, codebook_dim=512,
+                     num_layers=2, num_resnet_blocks=2, hidden_dim=256)
+
+
+def make_vae_measure(steps: int = 20, batch: int = 8):
+    """Compile a scan-of-steps DiscreteVAE train loop (the reference's
+    stage-1 batch size 8); each ``measure()`` returns (images_per_sec, dt)."""
+    from dalle_pytorch_tpu import DiscreteVAE
+    from dalle_pytorch_tpu.training import make_optimizer, make_vae_train_step
+
+    cfg = vae128_config()
+    vae = DiscreteVAE(cfg)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (batch, cfg.image_size, cfg.image_size, 3))
+    params = jax.jit(lambda r: vae.init({"params": r, "gumbel": r},
+                                        images[:1])["params"])(rng)
+    tx = make_optimizer(1e-3)
+    opt_state = jax.jit(tx.init)(params)
+    raw_step = make_vae_train_step(vae, tx, donate=False)
+
+    @functools.partial(jax.jit, static_argnames="n")
+    def run_steps(params, opt_state, rng, n):
+        def body(carry, _):
+            p, o, r = carry
+            r, k = jax.random.split(r)
+            p, o, loss, _ = raw_step(p, o, images, k, jnp.float32(1.0))
+            return (p, o, r), loss
+
+        (p, o, r), losses = jax.lax.scan(body, (params, opt_state, rng),
+                                         None, length=n)
+        return p, o, losses[-1]
+
+    return _scan_measure(run_steps, params, opt_state, rng, steps, batch)
 
 
 def make_gen_measure(batch: int = 8):

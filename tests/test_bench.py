@@ -136,3 +136,16 @@ def test_perf_ab_rejects_bad_args(monkeypatch, capsys):
         perf_ab.main(["palas"])
     with pytest.raises(SystemExit):
         perf_ab.main(["baseline", "--reps", "0"])
+
+
+def test_vae_measure_tiny(monkeypatch):
+    """make_vae_measure compiles and measures the stage-1 train loop."""
+    from dalle_pytorch_tpu import VAEConfig
+
+    monkeypatch.setattr(
+        bench, "vae128_config",
+        lambda: VAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                          num_layers=2, num_resnet_blocks=0, hidden_dim=16))
+    measure = bench.make_vae_measure(steps=2, batch=2)
+    ips, dt = measure()
+    assert ips > 0 and dt > 0

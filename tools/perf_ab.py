@@ -54,14 +54,15 @@ def main(argv=None) -> int:
     parser.add_argument("--list", action="store_true")
     args = parser.parse_args(argv)
     if args.list or not args.variants:
-        print("variants:", ", ".join(list(VARIANTS) + ["gen"]))
+        print("variants:", ", ".join(list(VARIANTS) + ["gen", "vae"]))
         return 0
     if args.reps < 1:
         parser.error("--reps must be >= 1")
-    unknown = [v for v in args.variants if v != "gen" and v not in VARIANTS]
+    unknown = [v for v in args.variants
+               if v not in ("gen", "vae") and v not in VARIANTS]
     if unknown:
         parser.error(f"unknown variant(s) {unknown}; choose from "
-                     f"{list(VARIANTS) + ['gen']}")
+                     f"{list(VARIANTS) + ['gen', 'vae']}")
 
     import bench
 
@@ -70,6 +71,8 @@ def main(argv=None) -> int:
         print(f"compiling {name}...", file=sys.stderr, flush=True)
         if name == "gen":
             measures[name] = bench.make_gen_measure()
+        elif name == "vae":
+            measures[name] = bench.make_vae_measure()
         else:
             measures[name] = bench.make_train_measure(
                 args.steps, **VARIANTS[name])[0]
