@@ -133,11 +133,22 @@ def generate_chunked(dalle, params, decode, text_tokens: np.ndarray, *,
 
 def host_fetch(tree):
     """Fetch a (possibly GSPMD-sharded) pytree to host numpy, multi-host
-    safe: with >1 process a plain `device_get` on arrays spanning
-    non-addressable devices raises, so every process participates in an
-    allgather and each gets the full value (root then writes the file)."""
+    safe.  Every process must call this together (collective): arrays that
+    span non-addressable devices — including arrays replicated over a
+    multi-host mesh — are reassembled with a tiled allgather so each
+    process ends up holding the FULL global value (root then writes the
+    file); only leaves living entirely on this process's devices are plain
+    device fetches."""
     if jax.process_count() == 1:
         return jax.device_get(tree)
     from jax.experimental import multihost_utils
 
-    return multihost_utils.process_allgather(tree, tiled=False)
+    def fetch(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            # tiled=True: concatenate the per-process shards back into the
+            # logical global array (tiled=False would stack a bogus leading
+            # process axis — and rejects global arrays outright)
+            return multihost_utils.process_allgather(leaf, tiled=True)
+        return jax.device_get(leaf)
+
+    return jax.tree.map(fetch, tree)

@@ -60,6 +60,16 @@ def is_process_zero() -> bool:
     return jax.process_index() == 0
 
 
+def _replicated_sharding():
+    """A concrete fully-replicated sharding over every device — the
+    placement shared by the sharded save's scalar lifting and the partial
+    restore, so the two can never drift apart."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    return NamedSharding(Mesh(np.asarray(jax.devices()), ("_all",)),
+                         PartitionSpec())
+
+
 def save_checkpoint_sharded(path: str | Path, obj: dict) -> None:
     """Orbax-backed save for sharded/multi-host training: arrays are written
     per-shard by the hosts that own them (no gather to process 0, unlike the
@@ -76,6 +86,33 @@ def save_checkpoint_sharded(path: str | Path, obj: dict) -> None:
             "'dalle-pytorch-tpu[sharded]'") from e
 
     path = Path(path).resolve()
+    if jax.process_count() > 1:
+        # host-local jax.Arrays (the jit-init optax count, the injected lr
+        # scalar from set_learning_rate) are unserializable multi-host;
+        # their values are identical on every process by construction, so
+        # lift them to replicated global arrays — after CHECKING that
+        # construction-time assumption: lifting divergent local buffers
+        # would silently persist an arbitrary process's value
+        from jax.experimental import multihost_utils
+
+        repl = _replicated_sharding()
+        local = [np.asarray(leaf) for leaf in jax.tree.leaves(obj)
+                 if (isinstance(leaf, jax.Array) and leaf.is_fully_addressable
+                     and len(leaf.devices()) < jax.device_count())]
+        if local:
+            multihost_utils.assert_equal(
+                local, "host-local checkpoint leaves diverge across "
+                       "processes; refusing to save an arbitrary one")
+
+        def globalize(leaf):
+            if (isinstance(leaf, jax.Array)
+                    and leaf.is_fully_addressable
+                    and len(leaf.devices()) < jax.device_count()):
+                return multihost_utils.host_local_array_to_global_array(
+                    np.asarray(leaf), repl.mesh, repl.spec)
+            return leaf
+
+        obj = jax.tree.map(globalize, obj)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, args=ocp.args.PyTreeSave(obj), force=True)
 
@@ -122,6 +159,10 @@ def load_sharded_small(path: str | Path):
     import orbax.checkpoint as ocp
 
     path = Path(path).resolve()
+    # 0-d leaves that were saved as (replicated) jax Arrays — optax count,
+    # the injected lr — must restore onto a concrete sharding; restoring
+    # them "by value" leaves the deserializer without one and fails
+    repl = _replicated_sharding()
     with ocp.PyTreeCheckpointer() as ckptr:
         meta = ckptr.metadata(path).item_metadata.tree
 
@@ -139,11 +180,15 @@ def load_sharded_small(path: str | Path):
                 return ocp.PLACEHOLDER
             dtype = getattr(node, "dtype", None)
             if dtype is not None:
+                if getattr(node, "sharding", None) is not None:
+                    return jax.ShapeDtypeStruct((), dtype, sharding=repl)
                 return np.zeros((), dtype)
             return ""  # string leaf
 
         item = to_item(meta)
-        return ckptr.restore(path, args=ocp.args.PyTreeRestore(item=item))
+        return ckptr.restore(path, args=ocp.args.PyTreeRestore(
+            item=item,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(item)))
 
 
 def migrate_qkv_kernels(tree, dim_head: int = 64):

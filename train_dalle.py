@@ -522,9 +522,18 @@ def main(argv=None):
                     just_checkpointed = i % 100 == 0
                     if just_checkpointed:
                         # periodic sample (ref :396-412): SPMD computation, so every
-                        # process runs it; only root writes the image
+                        # process runs it; only root writes the image.  The
+                        # caption must be globally consistent — each host's
+                        # loader yields different rows, and feeding divergent
+                        # "replicated" inputs to one SPMD program is undefined
                         rng, gen_rng = jax.random.split(rng)
-                        sample_text = jnp.asarray(text[:1].astype(np.int32))
+                        sample_text = text[:1].astype(np.int32)
+                        if jax.process_count() > 1:
+                            from jax.experimental import multihost_utils
+
+                            sample_text = multihost_utils.broadcast_one_to_all(
+                                sample_text)
+                        sample_text = jnp.asarray(sample_text)
                         codes = generate_codes(dalle, {'params': params},
                                                sample_text, gen_rng, filter_thres=0.9)
                         image = host_fetch(decode_images(vae_params, codes)[0])
