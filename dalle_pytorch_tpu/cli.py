@@ -105,7 +105,8 @@ def make_decode_fn(vae, vae_params):
 
 def generate_chunked(dalle, params, decode, text_tokens: np.ndarray, *,
                      batch_size: int, top_k: float, rng,
-                     temperature: float = 1.0, desc: str = 'generating'):
+                     temperature: float = 1.0, top_p: Optional[float] = None,
+                     desc: str = 'generating'):
     """Generate images for [n, text_seq_len] tokens in `batch_size` chunks.
 
     Pads the last chunk (keeping one compiled shape) and drops the padding
@@ -124,7 +125,8 @@ def generate_chunked(dalle, params, decode, text_tokens: np.ndarray, *,
         rng, key = jax.random.split(rng)
         codes = generate_codes(dalle, {'params': params},
                                jnp.asarray(chunk, jnp.int32), key,
-                               filter_thres=top_k, temperature=temperature)
+                               filter_thres=top_k, temperature=temperature,
+                               top_p=top_p)
         images = np.asarray(jax.device_get(decode(codes)))
         outs.append(images[: batch_size - pad] if pad else images)
         print(f'{desc}: {min(s + batch_size, n)}/{n}', flush=True)

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.transformer import Transformer
-from ..utils.helpers import max_neg_value, top_k_filter
+from ..utils.helpers import max_neg_value, top_k_filter, top_p_filter
 
 
 @dataclasses.dataclass(frozen=True)
@@ -357,13 +357,15 @@ class DALLE(nn.Module):
 
 def generate_codes(dalle: DALLE, params, text, rng, *, prime_codes=None,
                    filter_thres: float = 0.5, temperature: float = 1.0,
-                   mask=None) -> jax.Array:
+                   top_p: Optional[float] = None, mask=None) -> jax.Array:
     """Sample a full image token sequence [b, image_seq_len].
 
     Pure jittable function: prefill once, then a `lax.scan` KV-cache decode.
     Sampling semantics match the reference exactly (top_k filter with
     ``k = max(int((1-thres)*vocab), 1)``, temperature softmax, categorical
     draw, image-vocab offset subtraction; ref dalle_pytorch.py:400-415).
+    ``top_p`` additionally applies nucleus filtering after top-k (a knob
+    the reference lacks).
     """
     cfg = dalle.cfg
     n_prime = 0 if prime_codes is None else prime_codes.shape[1]
@@ -376,10 +378,17 @@ def generate_codes(dalle: DALLE, params, text, rng, *, prime_codes=None,
         # logits are image-vocab-only; k still derives from the full joint
         # vocab (reference semantics — its text entries were -inf and could
         # never win a slot), and the sampled index IS the image code (the
-        # reference's `- num_text_tokens` offset is pre-applied by slicing)
+        # reference's `- num_text_tokens` offset is pre-applied by slicing).
+        # Temperature scales BEFORE the filters: top-k is invariant to the
+        # monotone rescale (so reference top-k semantics are untouched) but
+        # the nucleus must be the p-mass set of the distribution actually
+        # sampled.
+        logits = logits / temperature
         filtered = top_k_filter(logits, thres=filter_thres,
                                 k_vocab=cfg.total_tokens)
-        tok = jax.random.categorical(key, filtered / temperature, axis=-1)
+        if top_p is not None:
+            filtered = top_p_filter(filtered, top_p)
+        tok = jax.random.categorical(key, filtered, axis=-1)
         return tok.astype(jnp.int32)
 
     rng, key0 = jax.random.split(rng)

@@ -66,3 +66,21 @@ def top_k_filter(logits: jax.Array, thres: float = 0.5,
     vals, _ = jax.lax.top_k(logits, k)
     kth = vals[..., -1:]
     return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering (beyond the reference, which only has top-k): keep
+    the smallest set of tokens whose softmax mass reaches ``p``, set the
+    rest to -inf.  The highest-probability token always survives.  Static
+    shapes throughout — jit/scan friendly."""
+    assert 0.0 < p <= 1.0, f"top_p must be in (0, 1], got {p}"
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i survives if the mass BEFORE it is < p (so the first token that
+    # crosses p is still included)
+    keep = (cum - probs) < p
+    # threshold = smallest surviving logit; everything below is cut
+    cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)

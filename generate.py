@@ -50,6 +50,10 @@ def parse_args(argv=None):
                         help='generation batch size')
     parser.add_argument('--top_k', type=float, default=0.9, required=False,
                         help='top-k filter threshold (0 - 1)')
+    parser.add_argument('--top_p', type=float, default=None, required=False,
+                        help='nucleus sampling: keep the smallest token set '
+                             'with this much probability mass (applied after '
+                             'top-k; the reference has no such knob)')
     parser.add_argument('--outputs_dir', type=str, default='./outputs',
                         required=False, help='output directory')
     parser.add_argument('--captions_pickle', type=str,
@@ -79,7 +83,7 @@ def main(argv=None):
             tokens = np.repeat(tokens, args.num_images, axis=0)
             images, rng = generate_chunked(
                 dalle, params, decode, tokens, batch_size=args.batch_size,
-                top_k=args.top_k, rng=rng,
+                top_k=args.top_k, top_p=args.top_p, rng=rng,
                 desc=f'generating images for - {text}')
 
             outputs_dir = Path(args.outputs_dir) / (
@@ -110,7 +114,7 @@ def main(argv=None):
             chunk = all_tokens[bb * big_batch: (bb + 1) * big_batch]
             images, rng = generate_chunked(
                 dalle, params, decode, chunk, batch_size=args.batch_size,
-                top_k=args.top_k, rng=rng,
+                top_k=args.top_k, top_p=args.top_p, rng=rng,
                 desc=f'generating images for - {bb}')
             for i, image in enumerate(images):
                 save_image(outputs_dir / f'{bb}-{i}.jpg', image)

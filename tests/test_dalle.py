@@ -198,3 +198,37 @@ def test_bf16_logits_close():
     assert b.dtype == np.float32
     finite = np.isfinite(a)
     np.testing.assert_allclose(a[finite], b[finite], atol=0.05, rtol=0.05)
+
+
+def test_top_p_filter_semantics():
+    from dalle_pytorch_tpu.utils.helpers import top_p_filter
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    out = np.asarray(top_p_filter(logits, 0.75))  # 0.5+0.3 crosses 0.75
+    assert np.isfinite(out[0, :2]).all() and np.isinf(out[0, 2:]).all()
+    out1 = np.asarray(top_p_filter(logits, 0.4))  # top token always kept
+    assert np.isfinite(out1[0, 0]) and np.isinf(out1[0, 1:]).all()
+    # p=1 keeps everything
+    assert np.isfinite(np.asarray(top_p_filter(logits, 1.0))).all()
+    # order-invariant: permuting the vocab permutes the mask identically
+    perm = np.asarray([2, 0, 3, 1])
+    out_p = np.asarray(top_p_filter(logits[:, perm], 0.75))
+    np.testing.assert_array_equal(np.isfinite(out_p[0]),
+                                  np.isfinite(out[0])[perm])
+
+
+def test_generate_with_top_p(small):
+    """Nucleus sampling runs inside the jitted decode scan and yields valid
+    image codes; p=1.0 (keep all) matches plain top-k sampling exactly."""
+    cfg, dalle, params, text, codes = small
+    out = np.asarray(generate_codes(dalle, params, text, jax.random.PRNGKey(0),
+                                    filter_thres=0.9, top_p=0.9))
+    assert out.shape == (2, cfg.image_seq_len)
+    assert (out >= 0).all() and (out < cfg.num_image_tokens).all()
+
+    plain = np.asarray(generate_codes(dalle, params, text,
+                                      jax.random.PRNGKey(0), filter_thres=0.9))
+    full = np.asarray(generate_codes(dalle, params, text,
+                                     jax.random.PRNGKey(0), filter_thres=0.9,
+                                     top_p=1.0))
+    np.testing.assert_array_equal(plain, full)
