@@ -173,6 +173,7 @@ def test_generate_cli(trained_dalle, tiny_tokenizer_json, workdir):
                        "--text", "red bird",
                        "--num_images", "2",
                        "--batch_size", "2",
+                       "--top_p", "0.9",
                        "--bpe_path", str(tiny_tokenizer_json),
                        "--outputs_dir", str(workdir / "outputs")])
     finally:
