@@ -279,6 +279,7 @@ def main():
     # MFU context on stderr; the driver consumes only the stdout JSON line.
     # FLOPs are dense-equivalent (sparse layers counted as full attention),
     # the convention MFU is normally quoted in for sparse models.
+    import os
     import sys
 
     from dalle_pytorch_tpu.utils.profiling import (dalle_train_flops,
@@ -287,22 +288,37 @@ def main():
     flops = dalle_train_flops(cfg, batch) * STEPS / dt
     print(f"achieved {flops/1e12:.2f} TFLOP/s (dense-equivalent), "
           f"MFU {flops/device_peak_flops():.2%}", file=sys.stderr)
-    try:
-        # same hang watchdog as training: a wedged tunnel here would block
-        # the stdout JSON line the driver is waiting on
-        import os as _os
+    # informational stages (stderr only), each under the hang watchdog — a
+    # wedged tunnel here would otherwise block the stdout JSON line the
+    # driver is waiting on.  Stages run strictly one at a time: if a stage
+    # times out but its thread stays wedged in a device call, later stages
+    # are skipped rather than measured concurrently with it.
+    wedged = None
 
-        t, box = _bounded_call(run_generate)
-        t.join(float(_os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 900)))
-        if t.is_alive():
-            raise TimeoutError("generation bench hung")
-        if "error" in box:
-            raise box["error"]
-        tok_per_sec, _ = box["result"]
-        print(f"generation: {tok_per_sec:.1f} image-tokens/sec "
-              "(KV-cache sampler)", file=sys.stderr)
-    except Exception as e:  # generation bench is informational only
-        print(f"generation bench skipped: {e}", file=sys.stderr)
+    def bounded_stage(label, fn, report):
+        nonlocal wedged
+        try:
+            if wedged is not None and wedged.is_alive():
+                raise TimeoutError(
+                    "previous stage still wedged in a device call")
+            t, box = _bounded_call(fn)
+            t.join(float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 900)))
+            if t.is_alive():
+                wedged = t
+                raise TimeoutError(f"{label} bench hung")
+            if "error" in box:
+                raise box["error"]
+            print(report(box["result"]), file=sys.stderr)
+        except Exception as e:  # informational only — never block the JSON
+            print(f"{label} bench skipped: {e}", file=sys.stderr)
+
+    bounded_stage(
+        "generation", run_generate,
+        lambda r: f"generation: {r[0]:.1f} image-tokens/sec "
+                  "(KV-cache sampler)")
+    if os.environ.get("BENCH_VAE"):  # opt-in stage-1 number (BASELINE cfg 1)
+        bounded_stage("vae", lambda: make_vae_measure()(),
+                      lambda r: f"vae train (128px): {r[0]:.2f} images/sec")
 
     print(json.dumps({
         "metric": "dalle_cub200_train_throughput",

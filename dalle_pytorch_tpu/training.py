@@ -11,8 +11,6 @@ retracing — replacing torch's stateful ``ExponentialLR`` /
 """
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import optax

@@ -18,7 +18,6 @@ rather than an explicit NCCL allreduce.
 from __future__ import annotations
 
 import argparse
-import math
 import time
 from pathlib import Path
 
