@@ -21,12 +21,51 @@ from __future__ import annotations
 
 import functools
 import json
+import os
+import subprocess
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
 STEPS = 50
+FIRST_STEPS = 15  # until a success lands, run fewer scan steps: minutes to JSON
+ATTEMPT_TIMEOUT_DEFAULT = 300.0  # shared by the retry loop, stages, and meta
+
+
+def _attempt_timeout() -> float:
+    return float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S",
+                                ATTEMPT_TIMEOUT_DEFAULT))
+
+
+def _tunnel_probe(timeout_s: float = None) -> None:
+    """Fail fast when the TPU tunnel is down: run a 1-element jitted op in a
+    *subprocess* under a hard timeout.  A dead tunnel can wedge ``import
+    jax`` or the first device call for many minutes with no exception, which
+    no in-process watchdog can bound — the subprocess boundary can.  Raises
+    TimeoutError/RuntimeError on a dead tunnel; returns quietly when healthy
+    or when the probe is moot (CPU-first platform, BENCH_SKIP_PROBE=1)."""
+    platforms = os.environ.get("JAX_PLATFORMS", "").split(",")
+    if os.environ.get("BENCH_SKIP_PROBE") or platforms[0].strip() == "cpu":
+        return
+    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S",
+                                     timeout_s or 60.0))
+    code = ("import jax, jax.numpy as jnp; "
+            "v = float(jax.jit(lambda x: (x @ x).sum())(jnp.ones((128, 128))));"
+            "assert v == 128.0 ** 3, v; print('probe ok')")
+    try:
+        subprocess.run([sys.executable, "-c", code], check=True,
+                       timeout=timeout_s, stdout=subprocess.DEVNULL,
+                       stderr=subprocess.PIPE)
+    except subprocess.TimeoutExpired:
+        raise TimeoutError(
+            f"tunnel probe did not finish a 128x128 matmul in {timeout_s:.0f}s"
+        ) from None
+    except subprocess.CalledProcessError as e:
+        tail = (e.stderr or b"")[-400:].decode("utf-8", "replace").strip()
+        raise RuntimeError(
+            f"tunnel probe failed (rc={e.returncode}): {tail}") from None
 
 
 def cub200_config(use_pallas: bool = False):
@@ -209,24 +248,37 @@ def _bounded_call(fn):
 def _run_with_retry(attempts: int = None, wait_s: float = None):
     """The remote TPU tunnel occasionally 500s or drops — sometimes for an
     hour at a stretch; a transient outage should not zero the round's
-    benchmark.  Measurement policy (declared from the first recorded round
-    so every round compares like-for-like): up to `attempts` tries (spaced
-    `wait_s` apart, both overridable via BENCH_ATTEMPTS / BENCH_WAIT_S),
-    report the best of the first two successes — the chip is shared and
-    single draws under-report device capability.  The policy is echoed on
-    stderr next to the result.  Each attempt is also bounded by a watchdog
-    (BENCH_ATTEMPT_TIMEOUT_S, default 900): a hung tunnel dispatch
-    otherwise blocks forever and the driver would record nothing at all."""
-    import os
-    import sys
+    benchmark, and a *wedged* tunnel must not consume the round's whole
+    budget either.  Measurement policy (echoed on stderr and in the JSON
+    metadata so every round compares like-for-like):
 
+    - each attempt starts with a cheap subprocess probe (`_tunnel_probe`,
+      ~60 s bound) so a dead tunnel costs seconds, not a hung compile — the
+      probe runs only after the wedged-previous-attempt check, so it can
+      never put a second workload on a busy chip;
+    - until the first success lands, attempts run FIRST_STEPS scan steps
+      (time-to-first-JSON is minutes even after failures), afterwards the
+      full STEPS;
+    - report the best of the first two successes — the chip is shared and
+      single draws under-report device capability;
+    - once one success is in hand, any later failure stops the loop
+      immediately (never trade a recorded number for a retry wait);
+    - every attempt is bounded by a watchdog (BENCH_ATTEMPT_TIMEOUT_S,
+      default ATTEMPT_TIMEOUT_DEFAULT) because a hung dispatch raises
+      nothing, ever.
+
+    Knobs: BENCH_ATTEMPTS / BENCH_WAIT_S / BENCH_ATTEMPT_TIMEOUT_S /
+    BENCH_STEPS / BENCH_PROBE_TIMEOUT_S / BENCH_SKIP_PROBE.
+
+    Returns ``(images_per_sec, dt, cfg, batch, steps, successes)``."""
     attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", attempts or 5)))
     wait_s = float(os.environ.get("BENCH_WAIT_S", wait_s or 120.0))
-    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 900))
+    attempt_timeout = _attempt_timeout()
+    full_steps = int(os.environ.get("BENCH_STEPS", STEPS))
 
     pending = None  # an abandoned (timed-out but alive) attempt thread
 
-    def run_bounded():
+    def run_bounded(steps):
         nonlocal pending
         if pending is not None and pending.is_alive():
             # never run two measurements on the chip at once — a stalled
@@ -237,7 +289,8 @@ def _run_with_retry(attempts: int = None, wait_s: float = None):
                     "previous bench attempt still wedged in a device call; "
                     "refusing to measure concurrently")
         pending = None
-        t, box = _bounded_call(lambda: run(use_pallas=False))
+        _tunnel_probe()  # after the wedge check: the probe touches the chip
+        t, box = _bounded_call(lambda: run(use_pallas=False, steps=steps))
         t.join(attempt_timeout)
         if t.is_alive():
             pending = t
@@ -252,11 +305,12 @@ def _run_with_retry(attempts: int = None, wait_s: float = None):
     successes = 0
     last_err = None
     for attempt in range(attempts):
+        steps = min(FIRST_STEPS, full_steps) if best is None else full_steps
         try:
-            result = run_bounded()
+            result = run_bounded(steps)
             successes += 1
             if best is None or result[0] > best[0]:
-                best = result
+                best = result + (steps,)
             if successes >= 2:  # best-of-2 bounds total runtime
                 break
         except AssertionError:
@@ -265,34 +319,50 @@ def _run_with_retry(attempts: int = None, wait_s: float = None):
             last_err = e
             print(f"bench attempt {attempt + 1}/{attempts} failed: {e}",
                   file=sys.stderr)
+            if best is not None:
+                break  # a recorded number beats waiting on a flaky tunnel
             if attempt < attempts - 1:
                 time.sleep(wait_s)
     if best is None:
         raise last_err
     print(f"measurement policy: best of {successes} successful run(s)",
           file=sys.stderr)
-    return best
+    return best + (successes,)
 
 
 def main():
-    images_per_sec, dt, cfg, batch = _run_with_retry()
+    images_per_sec, dt, cfg, batch, steps, successes = _run_with_retry()
     # MFU context on stderr; the driver consumes only the stdout JSON line.
     # FLOPs are dense-equivalent (sparse layers counted as full attention),
     # the convention MFU is normally quoted in for sparse models.
-    import os
-    import sys
-
     from dalle_pytorch_tpu.utils.profiling import (dalle_train_flops,
                                                    device_peak_flops)
 
-    flops = dalle_train_flops(cfg, batch) * STEPS / dt
+    flops = dalle_train_flops(cfg, batch) * steps / dt
     print(f"achieved {flops/1e12:.2f} TFLOP/s (dense-equivalent), "
           f"MFU {flops/device_peak_flops():.2%}", file=sys.stderr)
-    # informational stages (stderr only), each under the hang watchdog — a
-    # wedged tunnel here would otherwise block the stdout JSON line the
-    # driver is waiting on.  Stages run strictly one at a time: if a stage
-    # times out but its thread stays wedged in a device call, later stages
-    # are skipped rather than measured concurrently with it.
+    # The driver-facing JSON goes out the moment the headline number exists —
+    # the informational stages below must never be able to cost the round
+    # its recorded metric.  `meta` makes the measurement self-describing:
+    # codes_path=True means the hot loop consumes pre-tokenized VAE codes
+    # (the reference re-encodes images every step, ref dalle_pytorch.py:459;
+    # the VAE-in-loop number is the opt-in BENCH_VAE stage).
+    print(json.dumps({
+        "metric": "dalle_cub200_train_throughput",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "meta": {
+            "steps": steps, "batch": batch, "codes_path": True,
+            "use_pallas": False,
+            "attempt_policy": f"probe-first, best-of-{successes}, "
+                              f"watchdog {_attempt_timeout():.0f}s",
+        },
+    }), flush=True)
+    # informational stages (stderr only), each under the hang watchdog.
+    # Stages run strictly one at a time: if a stage times out but its
+    # thread stays wedged in a device call, later stages are skipped rather
+    # than measured concurrently with it.
     wedged = None
 
     def bounded_stage(label, fn, report):
@@ -302,7 +372,7 @@ def main():
                 raise TimeoutError(
                     "previous stage still wedged in a device call")
             t, box = _bounded_call(fn)
-            t.join(float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 900)))
+            t.join(_attempt_timeout())
             if t.is_alive():
                 wedged = t
                 raise TimeoutError(f"{label} bench hung")
@@ -319,13 +389,6 @@ def main():
     if os.environ.get("BENCH_VAE"):  # opt-in stage-1 number (BASELINE cfg 1)
         bounded_stage("vae", lambda: make_vae_measure()(),
                       lambda r: f"vae train (128px): {r[0]:.2f} images/sec")
-
-    print(json.dumps({
-        "metric": "dalle_cub200_train_throughput",
-        "value": round(images_per_sec, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": None,
-    }))
 
 
 if __name__ == "__main__":

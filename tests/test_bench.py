@@ -12,11 +12,19 @@ import pytest
 import bench
 
 
-def test_retry_survives_transient_failures(monkeypatch, capsys):
-    calls = {"n": 0}
+@pytest.fixture(autouse=True)
+def _no_probe(monkeypatch):
+    """The subprocess tunnel probe must never run under the test harness —
+    importing jax in a fresh subprocess would try the real TPU plugin."""
+    monkeypatch.setenv("BENCH_SKIP_PROBE", "1")
 
-    def flaky_run(use_pallas=False):
+
+def test_retry_survives_transient_failures(monkeypatch, capsys):
+    calls = {"n": 0, "steps": []}
+
+    def flaky_run(use_pallas=False, steps=None):
         calls["n"] += 1
+        calls["steps"].append(steps)
         if calls["n"] == 1:
             raise RuntimeError("tunnel 500")
         return (40.0 + calls["n"], 1.0, None, 16)
@@ -26,11 +34,65 @@ def test_retry_survives_transient_failures(monkeypatch, capsys):
     result = bench._run_with_retry()
     # first attempt failed, then best-of-2 successes (42, 43) -> 43
     assert calls["n"] == 3 and result[0] == 43.0
+    # short scans until a success lands, then the full one
+    assert calls["steps"] == [bench.FIRST_STEPS, bench.FIRST_STEPS,
+                              bench.STEPS]
+    assert result[4] == bench.STEPS  # steps of the best run, for metadata
+    assert result[5] == 2  # successes, for the attempt_policy metadata
     assert "measurement policy: best of 2" in capsys.readouterr().err
 
 
+def test_failure_after_first_success_stops_immediately(monkeypatch):
+    """Once a number is recorded, a flaky tunnel must not cost retry waits —
+    the loop returns what it has instead of sleeping toward a better draw."""
+    calls = {"n": 0}
+
+    def once_then_dead(use_pallas=False, steps=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return (41.0, 1.0, None, 16)
+        raise ConnectionError("tunnel dropped")
+
+    monkeypatch.setattr(bench, "run", once_then_dead)
+    monkeypatch.setenv("BENCH_ATTEMPTS", "5")
+    monkeypatch.setenv("BENCH_WAIT_S", "30")  # would be slept if buggy
+    t0 = time.monotonic()
+    result = bench._run_with_retry()
+    assert result[0] == 41.0 and calls["n"] == 2
+    assert time.monotonic() - t0 < 5  # no wait_s sleep after the success
+
+
+def test_probe_failure_skips_measurement(monkeypatch):
+    """A dead tunnel is detected by the cheap probe; the expensive compile
+    path is never entered and the error surfaces after the attempt budget."""
+    ran = {"n": 0}
+
+    def never_called(use_pallas=False, steps=None):
+        ran["n"] += 1
+        return (1.0, 1.0, None, 16)
+
+    monkeypatch.setattr(bench, "run", never_called)
+    monkeypatch.setattr(bench, "_tunnel_probe",
+                        lambda: (_ for _ in ()).throw(TimeoutError("probe")))
+    monkeypatch.setenv("BENCH_ATTEMPTS", "2")
+    monkeypatch.setenv("BENCH_WAIT_S", "0")
+    with pytest.raises(TimeoutError):
+        bench._run_with_retry()
+    assert ran["n"] == 0
+
+
+def test_probe_skipped_on_cpu_platform(monkeypatch):
+    """JAX_PLATFORMS=cpu (the test/CI environment) makes the probe a no-op
+    even without BENCH_SKIP_PROBE."""
+    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: pytest.fail("probe subprocess ran"))
+    bench._tunnel_probe()
+
+
 def test_retry_gives_up_after_attempts(monkeypatch):
-    def dead_run(use_pallas=False):
+    def dead_run(use_pallas=False, steps=None):
         raise ConnectionError("tunnel down")
 
     monkeypatch.setattr(bench, "run", dead_run)
@@ -41,7 +103,7 @@ def test_retry_gives_up_after_attempts(monkeypatch):
 
 
 def test_retry_never_masks_nonfinite_loss(monkeypatch):
-    def bad_loss_run(use_pallas=False):
+    def bad_loss_run(use_pallas=False, steps=None):
         raise AssertionError("non-finite bench loss")
 
     monkeypatch.setattr(bench, "run", bad_loss_run)
@@ -57,7 +119,7 @@ def test_watchdog_bounds_hung_attempt(monkeypatch):
     chip at once)."""
     hung = {"n": 0}
 
-    def slow_then_ok(use_pallas=False):
+    def slow_then_ok(use_pallas=False, steps=None):
         hung["n"] += 1
         if hung["n"] == 1:
             time.sleep(1.0)  # exceeds the watchdog below, then finishes
@@ -74,7 +136,7 @@ def test_watchdog_bounds_hung_attempt(monkeypatch):
 def test_watchdog_refuses_concurrent_measurement(monkeypatch):
     """A wedged-forever attempt must not overlap with a new measurement —
     retries give up rather than run two workloads on the chip at once."""
-    def wedged(use_pallas=False):
+    def wedged(use_pallas=False, steps=None):
         time.sleep(60)
         return (1.0, 1.0, None, 16)
 
@@ -90,13 +152,42 @@ def test_watchdog_refuses_concurrent_measurement(monkeypatch):
 
 def test_retry_env_attempts_clamped(monkeypatch):
     """BENCH_ATTEMPTS=0 must mean one attempt, not an opaque 'raise None'."""
-    def ok_run(use_pallas=False):
+    def ok_run(use_pallas=False, steps=None):
         return (10.0, 1.0, None, 16)
 
     monkeypatch.setattr(bench, "run", ok_run)
     monkeypatch.setenv("BENCH_ATTEMPTS", "0")
     monkeypatch.setenv("BENCH_WAIT_S", "0")
     assert bench._run_with_retry()[0] == 10.0
+
+
+def test_main_emits_json_before_stages(monkeypatch, capsys):
+    """The driver-facing JSON line (with self-describing meta) must be on
+    stdout even when every informational stage dies — and nothing else may
+    share stdout with it."""
+    import json
+
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import DALLEConfig
+
+    cfg = DALLEConfig(dim=32, num_text_tokens=64, text_seq_len=8, depth=2,
+                      heads=2, dim_head=16, attn_types=("full",),
+                      num_image_tokens=32, image_size=32, image_fmap_size=4,
+                      dtype=jnp.float32)
+    monkeypatch.setattr(bench, "_run_with_retry",
+                        lambda: (42.5, 1.0, cfg, 16, bench.FIRST_STEPS, 1))
+    monkeypatch.setattr(
+        bench, "run_generate",
+        lambda: (_ for _ in ()).throw(RuntimeError("stage boom")))
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    parsed = json.loads(out[0])
+    assert parsed["value"] == 42.5
+    assert parsed["meta"]["steps"] == bench.FIRST_STEPS
+    assert parsed["meta"]["codes_path"] is True
+    assert parsed["meta"]["use_pallas"] is False
 
 
 def test_perf_ab_tool(monkeypatch, capsys):
