@@ -39,18 +39,29 @@ def _attempt_timeout() -> float:
                                 ATTEMPT_TIMEOUT_DEFAULT))
 
 
+def _probe_enabled() -> bool:
+    platforms = os.environ.get("JAX_PLATFORMS", "").split(",")
+    return not (os.environ.get("BENCH_SKIP_PROBE")
+                or platforms[0].strip() == "cpu")
+
+
+def _probe_timeout() -> float:
+    return float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 60.0))
+
+
 def _tunnel_probe(timeout_s: float = None) -> None:
     """Fail fast when the TPU tunnel is down: run a 1-element jitted op in a
     *subprocess* under a hard timeout.  A dead tunnel can wedge ``import
     jax`` or the first device call for many minutes with no exception, which
-    no in-process watchdog can bound — the subprocess boundary can.  Raises
-    TimeoutError/RuntimeError on a dead tunnel; returns quietly when healthy
-    or when the probe is moot (CPU-first platform, BENCH_SKIP_PROBE=1)."""
-    platforms = os.environ.get("JAX_PLATFORMS", "").split(",")
-    if os.environ.get("BENCH_SKIP_PROBE") or platforms[0].strip() == "cpu":
+    no in-process watchdog can bound — the subprocess boundary can.  Only
+    used *before* this process touches the device: once an in-process
+    client exists, `_probe_in_process` is the safe form (a second client
+    from a subprocess could conflict on exclusive-access runtimes).
+    Raises TimeoutError/RuntimeError on a dead tunnel; returns quietly when
+    the probe is moot (CPU-first platform, BENCH_SKIP_PROBE=1)."""
+    if not _probe_enabled():
         return
-    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S",
-                                     timeout_s or 60.0))
+    timeout_s = timeout_s or _probe_timeout()
     code = ("import jax, jax.numpy as jnp; "
             "v = float(jax.jit(lambda x: (x @ x).sum())(jnp.ones((128, 128))));"
             "assert v == 128.0 ** 3, v; print('probe ok')")
@@ -66,6 +77,17 @@ def _tunnel_probe(timeout_s: float = None) -> None:
         tail = (e.stderr or b"")[-400:].decode("utf-8", "replace").strip()
         raise RuntimeError(
             f"tunnel probe failed (rc={e.returncode}): {tail}") from None
+
+
+def _probe_in_process() -> None:
+    """The post-first-device-call probe: same tiny matmul, run through this
+    process's existing client under the watchdog (no second client)."""
+    if not _probe_enabled():
+        return
+    def tiny():
+        return float(jax.jit(lambda x: (x @ x).sum())(jnp.ones((128, 128))))
+    v = _bounded_device_call(tiny, _probe_timeout(), "in-process probe")
+    assert v == 128.0 ** 3, v
 
 
 def cub200_config(use_pallas: bool = False):
@@ -245,6 +267,42 @@ def _bounded_call(fn):
     return t, box
 
 
+# One wedge registry for the WHOLE process — the retry loop, the probes and
+# the informational stages all funnel device work through it, so a thread
+# that timed out but stayed wedged in a device call blocks every later
+# device workload, not just the ones its own scope knows about ("never two
+# measurements on the chip at once").
+_wedge = {"thread": None}
+
+
+def _wedge_guard(wait_s: float = 0.0) -> None:
+    """Refuse to start device work while an abandoned call is still alive
+    (optionally giving it ``wait_s`` to finish first)."""
+    t = _wedge["thread"]
+    if t is not None and t.is_alive():
+        if wait_s:
+            t.join(wait_s)
+        if t.is_alive():
+            raise TimeoutError(
+                "a previous bench call is still wedged in a device call; "
+                "refusing to measure concurrently")
+    _wedge["thread"] = None
+
+
+def _bounded_device_call(fn, timeout_s: float, label: str):
+    """Run ``fn`` under the watchdog; on timeout, register the still-alive
+    thread in the process-wide wedge registry and raise."""
+    t, box = _bounded_call(fn)
+    t.join(timeout_s)
+    if t.is_alive():
+        _wedge["thread"] = t
+        raise TimeoutError(
+            f"{label} still running after {timeout_s:.0f}s (tunnel hang?)")
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
 def _run_with_retry(attempts: int = None, wait_s: float = None):
     """The remote TPU tunnel occasionally 500s or drops — sometimes for an
     hour at a stretch; a transient outage should not zero the round's
@@ -252,10 +310,13 @@ def _run_with_retry(attempts: int = None, wait_s: float = None):
     budget either.  Measurement policy (echoed on stderr and in the JSON
     metadata so every round compares like-for-like):
 
-    - each attempt starts with a cheap subprocess probe (`_tunnel_probe`,
-      ~60 s bound) so a dead tunnel costs seconds, not a hung compile — the
-      probe runs only after the wedged-previous-attempt check, so it can
-      never put a second workload on a busy chip;
+    - until the first success, each attempt starts with a cheap probe
+      (~60 s bound) so a dead tunnel costs seconds, not a hung compile: a
+      *subprocess* probe before this process ever touches the device (a
+      dead tunnel can wedge ``import jax`` itself), an in-process bounded
+      probe afterwards (a second client could conflict on exclusive-access
+      runtimes).  After a success the probe is skipped — the chip was
+      demonstrably healthy seconds ago;
     - until the first success lands, attempts run FIRST_STEPS scan steps
       (time-to-first-JSON is minutes even after failures), afterwards the
       full STEPS;
@@ -264,8 +325,12 @@ def _run_with_retry(attempts: int = None, wait_s: float = None):
     - once one success is in hand, any later failure stops the loop
       immediately (never trade a recorded number for a retry wait);
     - every attempt is bounded by a watchdog (BENCH_ATTEMPT_TIMEOUT_S,
-      default ATTEMPT_TIMEOUT_DEFAULT) because a hung dispatch raises
-      nothing, ever.
+      default ATTEMPT_TIMEOUT_DEFAULT), doubled while no success has
+      landed yet — pre-success attempts pay the XLA compile, which
+      dominates and can exceed the base bound on a slow-but-alive tunnel;
+    - a timed-out-but-alive attempt is registered in the process-wide
+      wedge registry, so neither later attempts nor main()'s informational
+      stages can overlap it on the chip.
 
     Knobs: BENCH_ATTEMPTS / BENCH_WAIT_S / BENCH_ATTEMPT_TIMEOUT_S /
     BENCH_STEPS / BENCH_PROBE_TIMEOUT_S / BENCH_SKIP_PROBE.
@@ -276,38 +341,23 @@ def _run_with_retry(attempts: int = None, wait_s: float = None):
     attempt_timeout = _attempt_timeout()
     full_steps = int(os.environ.get("BENCH_STEPS", STEPS))
 
-    pending = None  # an abandoned (timed-out but alive) attempt thread
-
-    def run_bounded(steps):
-        nonlocal pending
-        if pending is not None and pending.is_alive():
-            # never run two measurements on the chip at once — a stalled
-            # previous attempt would skew this one and both would be wrong
-            pending.join(wait_s)
-            if pending.is_alive():
-                raise TimeoutError(
-                    "previous bench attempt still wedged in a device call; "
-                    "refusing to measure concurrently")
-        pending = None
-        _tunnel_probe()  # after the wedge check: the probe touches the chip
-        t, box = _bounded_call(lambda: run(use_pallas=False, steps=steps))
-        t.join(attempt_timeout)
-        if t.is_alive():
-            pending = t
-            raise TimeoutError(
-                f"bench attempt still running after {attempt_timeout:.0f}s "
-                "(tunnel hang?)")
-        if "error" in box:
-            raise box["error"]
-        return box["result"]
-
     best = None
     successes = 0
     last_err = None
+    device_touched = False  # has THIS process dispatched device work yet?
     for attempt in range(attempts):
         steps = min(FIRST_STEPS, full_steps) if best is None else full_steps
+        # compile dominates until the first success; after one, bound the
+        # extra draw tightly — we already have a number to fall back on
+        timeout = attempt_timeout * 2 if best is None else attempt_timeout
         try:
-            result = run_bounded(steps)
+            _wedge_guard(wait_s)
+            if best is None:
+                (_probe_in_process if device_touched else _tunnel_probe)()
+            device_touched = True
+            result = _bounded_device_call(
+                lambda: run(use_pallas=False, steps=steps),
+                timeout, "bench attempt")
             successes += 1
             if best is None or result[0] > best[0]:
                 best = result + (steps,)
@@ -360,26 +410,17 @@ def main():
         },
     }), flush=True)
     # informational stages (stderr only), each under the hang watchdog.
-    # Stages run strictly one at a time: if a stage times out but its
-    # thread stays wedged in a device call, later stages are skipped rather
-    # than measured concurrently with it.
-    wedged = None
+    # The process-wide wedge registry serializes them against each other
+    # AND against any timed-out-but-alive measurement attempt: a wedged
+    # thread anywhere means later stages are skipped rather than measured
+    # concurrently with it.
 
     def bounded_stage(label, fn, report):
-        nonlocal wedged
         try:
-            if wedged is not None and wedged.is_alive():
-                raise TimeoutError(
-                    "previous stage still wedged in a device call")
-            t, box = _bounded_call(fn)
-            t.join(_attempt_timeout())
-            if t.is_alive():
-                wedged = t
-                raise TimeoutError(f"{label} bench hung")
-            if "error" in box:
-                raise box["error"]
-            print(report(box["result"]), file=sys.stderr)
-        except Exception as e:  # informational only — never block the JSON
+            _wedge_guard()
+            result = _bounded_device_call(fn, _attempt_timeout(), label)
+            print(report(result), file=sys.stderr)
+        except Exception as e:  # informational only — the JSON is already out
             print(f"{label} bench skipped: {e}", file=sys.stderr)
 
     bounded_stage(

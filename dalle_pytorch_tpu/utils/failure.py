@@ -92,12 +92,12 @@ class GracefulShutdown:
         Single-process: just the local flag.  Multi-process: every
         ``check_every`` steps all processes agree on OR(local flags) via the
         backend's ``average_all`` (flags are 0/1, so mean > 0 iff any set).
-        The default checks *every* step — the collective is a single scalar
-        (microseconds over ICI/DCN, negligible next to any real train step)
-        and it bounds signal-to-checkpoint latency to one step, which
-        matters inside a preemption grace window.  A larger ``check_every``
-        must be called symmetrically by every process — pass the global
-        step so the modulo lines up.
+        Note the multi-process collective *blocks the host*; a loop that
+        already averages a per-step metric should use
+        :meth:`average_and_poll` instead, which rides the stop flag on that
+        existing collective for free.  A ``check_every`` larger than 1 must
+        be called symmetrically by every process — pass the global step so
+        the modulo lines up.
         """
         if jax.process_count() <= 1 or backend is None:
             return self._requested
@@ -105,6 +105,23 @@ class GracefulShutdown:
             return False
         flag = np.float32(1.0 if self._requested else 0.0)
         return float(backend.average_all(flag)) > 0.0
+
+    def average_and_poll(self, backend, value) -> tuple:
+        """Average a per-step host metric *and* decide the collective stop
+        in one collective: returns ``(mean_value, stop)``.
+
+        The train loops already block once per step to average the loss
+        across processes; gathering ``[loss, stop_flag]`` as a single
+        2-vector makes the preemption check free instead of doubling the
+        per-step host collectives.  Every process must call this
+        symmetrically (same as the loss averaging it replaces).
+        """
+        if backend is None or jax.process_count() <= 1:
+            return float(value), self._requested
+        pair = np.asarray([np.float32(value),
+                           np.float32(1.0 if self._requested else 0.0)])
+        avg = backend.average_all(pair)
+        return float(avg[0]), float(avg[1]) > 0.0
 
 
 class Heartbeat:

@@ -6,6 +6,8 @@ functional JAX codebase: no in-place ops, no `.training` flags, explicit RNG.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -46,7 +48,7 @@ def l2norm(t: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
 
 
 def top_k_filter(logits: jax.Array, thres: float = 0.5,
-                 k_vocab: int = None) -> jax.Array:
+                 k_vocab: Optional[int] = None) -> jax.Array:
     """Keep the top `max(int((1-thres)*V), 1)` logits, set the rest to -inf.
 
     Exact semantics of the reference sampler filter

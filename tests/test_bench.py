@@ -15,8 +15,13 @@ import bench
 @pytest.fixture(autouse=True)
 def _no_probe(monkeypatch):
     """The subprocess tunnel probe must never run under the test harness —
-    importing jax in a fresh subprocess would try the real TPU plugin."""
+    importing jax in a fresh subprocess would try the real TPU plugin.
+    Also reset the process-wide wedge registry so one test's simulated
+    wedged thread can't poison the next test."""
     monkeypatch.setenv("BENCH_SKIP_PROBE", "1")
+    bench._wedge["thread"] = None
+    yield
+    bench._wedge["thread"] = None
 
 
 def test_retry_survives_transient_failures(monkeypatch, capsys):
@@ -79,6 +84,68 @@ def test_probe_failure_skips_measurement(monkeypatch):
     with pytest.raises(TimeoutError):
         bench._run_with_retry()
     assert ran["n"] == 0
+
+
+def test_probe_skipped_after_success(monkeypatch):
+    """Once a success proves the tunnel healthy, later attempts skip the
+    probe entirely; and the subprocess probe is only ever used before this
+    process first touches the device."""
+    calls = {"probe": 0, "run": 0}
+
+    def ok_run(use_pallas=False, steps=None):
+        calls["run"] += 1
+        return (40.0 + calls["run"], 1.0, None, 16)
+
+    monkeypatch.setattr(bench, "run", ok_run)
+    monkeypatch.setattr(
+        bench, "_tunnel_probe",
+        lambda: calls.__setitem__("probe", calls["probe"] + 1))
+    monkeypatch.setattr(
+        bench, "_probe_in_process",
+        lambda: pytest.fail("in-process probe before any device use"))
+    monkeypatch.setenv("BENCH_WAIT_S", "0")
+    result = bench._run_with_retry()
+    assert calls["run"] == 2 and result[0] == 42.0
+    assert calls["probe"] == 1  # attempt 1 only; attempt 2 followed a success
+
+
+def test_stages_refuse_while_attempt_wedged(monkeypatch, capsys):
+    """A timed-out measurement thread that is still wedged in a device call
+    must also block main()'s informational stages — the wedge registry is
+    process-wide, not per-scope."""
+    import json
+    import threading
+
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import DALLEConfig
+
+    cfg = DALLEConfig(dim=32, num_text_tokens=64, text_seq_len=8, depth=2,
+                      heads=2, dim_head=16, attn_types=("full",),
+                      num_image_tokens=32, image_size=32, image_fmap_size=4,
+                      dtype=jnp.float32)
+    release = threading.Event()
+    wedged = threading.Thread(target=release.wait, daemon=True)
+    wedged.start()
+
+    def retry_with_wedge():
+        bench._wedge["thread"] = wedged  # as a timed-out attempt would
+        return (42.5, 1.0, cfg, 16, bench.STEPS, 1)
+
+    ran_stage = {"gen": False}
+    monkeypatch.setattr(bench, "_run_with_retry", retry_with_wedge)
+    monkeypatch.setattr(bench, "run_generate",
+                        lambda: ran_stage.__setitem__("gen", True) or (1.0, 1.0))
+    try:
+        bench.main()
+    finally:
+        release.set()
+    captured = capsys.readouterr()
+    assert "generation bench skipped" in captured.err
+    assert "wedged" in captured.err
+    assert not ran_stage["gen"]
+    # the JSON still went out despite the wedge
+    assert json.loads(captured.out.strip())["value"] == 42.5
 
 
 def test_probe_skipped_on_cpu_platform(monkeypatch):
@@ -227,6 +294,8 @@ def test_perf_ab_rejects_bad_args(monkeypatch, capsys):
         perf_ab.main(["palas"])
     with pytest.raises(SystemExit):
         perf_ab.main(["baseline", "--reps", "0"])
+    with pytest.raises(SystemExit):  # repeated names would silently collapse
+        perf_ab.main(["baseline", "baseline"])
 
 
 def test_vae_measure_tiny(monkeypatch):

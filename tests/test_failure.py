@@ -33,6 +33,42 @@ def test_graceful_shutdown_sigint_too():
     assert signal.getsignal(signal.SIGINT) is not stopper._handler
 
 
+def test_average_and_poll_single_process():
+    """Single process: metric passes through, stop mirrors the local flag."""
+    with GracefulShutdown() as stopper:
+        avg, stop = stopper.average_and_poll(None, 3.5)
+        assert avg == 3.5 and not stop
+        signal.raise_signal(signal.SIGTERM)
+        avg, stop = stopper.average_and_poll(None, 1.25)
+        assert avg == 1.25 and stop
+
+
+def test_average_and_poll_one_collective(monkeypatch):
+    """Multi-process: the loss mean and the OR'd stop flag share ONE
+    backend collective (a 2-vector), never two per step."""
+    import numpy as np
+
+    import dalle_pytorch_tpu.utils.failure as fail
+
+    class FakeBackend:
+        def __init__(self):
+            self.calls = []
+
+        def average_all(self, value):
+            self.calls.append(np.asarray(value))
+            # simulate a peer at loss 2.0 whose stop flag is set
+            peer = np.asarray([2.0, 1.0], np.float32)
+            return (np.asarray(value, np.float32) + peer) / 2
+
+    monkeypatch.setattr(fail.jax, "process_count", lambda: 2)
+    backend = FakeBackend()
+    with GracefulShutdown() as stopper:
+        avg, stop = stopper.average_and_poll(backend, 4.0)
+    assert len(backend.calls) == 1 and backend.calls[0].shape == (2,)
+    assert avg == 3.0  # mean(4.0, 2.0)
+    assert stop  # any process's flag stops everyone (mean > 0)
+
+
 def test_heartbeat_file_and_external_stall_check(tmp_path):
     hb = Heartbeat(tmp_path, beat_interval=1000)
     try:
@@ -91,6 +127,11 @@ def test_monitor_cli(tmp_path, capsys):
     import monitor
 
     assert monitor.main([str(tmp_path)]) == 2  # no heartbeats yet
+
+    # a leftover file matching the glob but not the name pattern must be
+    # skipped, not crash the babysitter
+    (tmp_path / "heartbeat-pXcopy.json").write_text("{}")
+    assert monitor.main([str(tmp_path)]) == 2
 
     hb = Heartbeat(tmp_path)
     try:

@@ -28,8 +28,13 @@ from dalle_pytorch_tpu.utils.failure import Heartbeat  # noqa: E402
 
 
 def scan(directory: Path, timeout: float, expect: int | None) -> int:
-    files = sorted(directory.glob("heartbeat-p*.json"),
-                   key=lambda p: int(re.search(r"p(\d+)", p.stem).group(1)))
+    # filter the glob through the exact name pattern: a leftover temp/copy
+    # like heartbeat-p0.json.bak or heartbeat-pX.json must be skipped, not
+    # crash the babysitter
+    files = sorted(
+        (int(m.group(1)), p)
+        for p in directory.glob("heartbeat-p*.json")
+        if (m := re.fullmatch(r"heartbeat-p(\d+)", p.stem)))
     if not files:
         print(f"no heartbeat files in {directory}", file=sys.stderr)
         return 2
@@ -37,8 +42,7 @@ def scan(directory: Path, timeout: float, expect: int | None) -> int:
     now = time.time()
     bad = 0
     seen = set()
-    for path in files:
-        proc = int(re.search(r"p(\d+)", path.stem).group(1))
+    for proc, path in files:
         seen.add(proc)
         stalled = Heartbeat.is_stalled(path, timeout, now=now)
         done = False

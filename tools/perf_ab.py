@@ -63,6 +63,12 @@ def main(argv=None) -> int:
     if unknown:
         parser.error(f"unknown variant(s) {unknown}; choose from "
                      f"{list(VARIANTS) + ['gen', 'vae']}")
+    dupes = sorted({v for v in args.variants if args.variants.count(v) > 1})
+    if dupes:
+        # the measurement dict is keyed by name — a repeated variant would be
+        # silently measured once, which reads like two independent draws
+        parser.error(f"duplicate variant(s) {dupes}: each name gets one "
+                     "measurement slot; use --reps for repeated measurement")
 
     import bench
 

@@ -483,6 +483,10 @@ def main(argv=None):
                 # data loading + log IO).  The pmean dispatch is async; float() of
                 # step i's loss happens after step i+1 is already in flight.
                 pending = None  # (iter index, device loss)
+                # collective stop flag, updated by flush: the preemption
+                # check rides the per-step loss collective (one host
+                # collective per step, not two)
+                stop_poll = [False]
 
                 def flush(pending):
                     if pending is None:
@@ -490,7 +494,8 @@ def main(argv=None):
                     it, loss_dev = pending
                     # average_all here, not at dispatch: the multi-host impl blocks
                     # (process_allgather), which would kill the one-step deferral
-                    avg_loss = float(distr_backend.average_all(loss_dev))
+                    avg_loss, stop_poll[0] = stopper.average_and_poll(
+                        distr_backend, loss_dev)
                     perf = timer.tick(BATCH_SIZE * jax.process_count())
                     epoch_losses.append(avg_loss)
                     logger.step(epoch, it, avg_loss, lr, extra=perf)
@@ -547,9 +552,13 @@ def main(argv=None):
                     global_step += 1
                     if heartbeat is not None:
                         heartbeat.beat(global_step, epoch=epoch, loss_iter=i)
-                    if stopper.should_stop(distr_backend, step=global_step):
-                        # collective decision: every process exits here together, so
-                        # the collective save below cannot deadlock
+                    # multi-process: the collective decision from the last
+                    # flush (every process saw the same 2-vector, so every
+                    # process breaks at the same step — the collective save
+                    # below cannot deadlock); single-process: the local flag,
+                    # which is fresher by one step
+                    if stop_poll[0] if jax.process_count() > 1 \
+                            else stopper.requested:
                         flush(pending)
                         pending = None
                         resume_path = ('./dalle.pt.orbax' if args.sharded_checkpoints

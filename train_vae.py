@@ -295,6 +295,7 @@ def main(argv=None):
     temp = temp_sched.value
     interrupted = False
     completed = False
+    stop_poll = False  # collective stop flag from the last 10-step poll
     # preemption-safe shutdown + stall detection (SURVEY.md §5.3)
     stopper = GracefulShutdown()
     heartbeat = (Heartbeat(args.heartbeat_dir,
@@ -346,14 +347,23 @@ def main(argv=None):
                         opt_state = set_learning_rate(opt_state, lr)
 
                     if i % 10 == 0:
-                        avg_loss = float(distr_backend.average_all(loss))
+                        # the preemption check rides the existing 10-step loss
+                        # collective (multi-host stop latency <= 10 fast VAE
+                        # steps, well inside any preemption grace window)
+                        avg_loss, stop_poll = stopper.average_and_poll(
+                            distr_backend, loss)
                         dt, t_step = time.perf_counter() - t_step, time.perf_counter()
                         logger.step(epoch, i, avg_loss, lr,
                                     extra={'temperature': temp, 'sec_per_10steps': dt})
                     global_step += 1
                     if heartbeat is not None:
                         heartbeat.beat(global_step, epoch=epoch)
-                    if stopper.should_stop(distr_backend, step=global_step):
+                    # multi-process: the collective decision from the last
+                    # 10-step poll (symmetric across processes, so the
+                    # collective save below cannot deadlock); single-process:
+                    # the fresher local flag
+                    if stop_poll if jax.process_count() > 1 \
+                            else stopper.requested:
                         resume_path = save_vae_model('vae.pt', epoch)
                         if distr_backend.is_root_worker():
                             print(f'interrupted at epoch {epoch} iter {i}: resume '
