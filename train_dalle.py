@@ -309,7 +309,7 @@ def main(argv=None):
         # first would only raise the restore's peak memory
         opt_state = jax.eval_shape(tx.init, params)
     else:
-        opt_state = jax.jit(tx.init)(params)
+        opt_state = part.init_opt_state(tx, params)
     if resume_sharded is not None:
         # phase 2 of the elastic resume: swap each array placeholder for a
         # ShapeDtypeStruct carrying THIS run's sharding (params/opt/vae
@@ -320,17 +320,10 @@ def main(argv=None):
         target = dict(resume_ckpt)
         target['weights'] = params  # already ShapeDtypeStructs w/ shardings
         if 'opt_state' in resume_ckpt:
-            # the partitioner path rules apply to the adam moments too
-            # (their paths end in the same param names); scalar leaves
-            # (count, injected lr) fall through to replicated
-            opt_sds = [
-                jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s)
-                for t, s in zip(
-                    jax.tree.leaves(opt_state),
-                    jax.tree.leaves(part.param_shardings(opt_state)))]
             target['opt_state'] = [
                 sds if saved is ... else saved
-                for sds, saved in zip(opt_sds, resume_ckpt['opt_state'])]
+                for sds, saved in zip(part.opt_state_templates(opt_state),
+                                      resume_ckpt['opt_state'])]
         # ckpt VAE weights are used only when nothing else supplied them
         # (--vae_path wins, matching the msgpack path's precedence); when
         # skipped, their placeholders in `target` make the restore skip
@@ -357,7 +350,7 @@ def main(argv=None):
                                            fitted)
         else:
             # weights-only checkpoint: fall back to fresh optimizer state
-            opt_state = jax.jit(tx.init)(params)
+            opt_state = part.init_opt_state(tx, params)
         if vae_from_ckpt:
             vae_params = restored['vae_weights']
         elif is_custom_vae:

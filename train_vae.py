@@ -203,14 +203,10 @@ def main(argv=None):
         target = dict(resume_ckpt)
         target['weights'] = params
         if 'opt_state' in resume_ckpt:
-            opt_sds = [
-                jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s)
-                for t, s in zip(
-                    jax.tree.leaves(opt_state),
-                    jax.tree.leaves(part.param_shardings(opt_state)))]
             target['opt_state'] = [
                 sds if saved is ... else saved
-                for sds, saved in zip(opt_sds, resume_ckpt['opt_state'])]
+                for sds, saved in zip(part.opt_state_templates(opt_state),
+                                      resume_ckpt['opt_state'])]
         restored = load_checkpoint_sharded(resume_sharded, target=target)
         params = restored['weights']
         fitted = [
@@ -221,9 +217,9 @@ def main(argv=None):
             for tmpl, v in zip(jax.tree.leaves(opt_state),
                                restored.get('opt_state', []))]
         opt_state = (jax.tree.unflatten(jax.tree.structure(opt_state), fitted)
-                     if fitted else jax.jit(tx.init)(params))
+                     if fitted else part.init_opt_state(tx, params))
     else:
-        opt_state = jax.jit(tx.init)(params)
+        opt_state = part.init_opt_state(tx, params)
         if resume_ckpt is not None and 'opt_state' in resume_ckpt:
             opt_state = jax.tree.map(
                 lambda tmpl, v: (jnp.asarray(v).astype(tmpl.dtype)
