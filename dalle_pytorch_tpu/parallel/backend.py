@@ -142,6 +142,40 @@ class SingleBackend(DistributedBackend):
         return value
 
 
+# Env vars consulted by _cluster_env_hints (exported so tests can clear
+# exactly this set when simulating a hint-free host).
+CLUSTER_HINT_VARS = ("MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+                     "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE")
+
+
+def _cluster_env_hints() -> list:
+    """Environment markers that this process was launched as part of a
+    *multi-host* job (TPU pod / MegaScale / SLURM / OpenMPI).  When any is
+    present, a failed ``jax.distributed.initialize`` must be fatal: silently
+    degrading to world_size=1 would train N independent model copies — the
+    worst kind of quiet corruption on a real pod.
+
+    Every check is count-based, not presence-based: single-host TPU VMs set
+    e.g. a one-entry ``TPU_WORKER_HOSTNAMES`` too, and there the soft
+    single-process fallback is the correct behavior."""
+    import os
+
+    hints = []
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        hints.append("MEGASCALE_COORDINATOR_ADDRESS")  # multislice-only var
+    workers = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+               if h.strip()]
+    if len(workers) > 1:
+        hints.append("TPU_WORKER_HOSTNAMES")
+    for var in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE"):
+        try:
+            if int(os.environ.get(var, "0")) > 1:
+                hints.append(var)
+        except ValueError:
+            pass
+    return hints
+
+
 class GSPMDBackend(DistributedBackend):
     """Multi-host backend over the JAX distributed runtime + GSPMD."""
 
@@ -184,9 +218,20 @@ class GSPMDBackend(DistributedBackend):
         except Exception as e:
             if explicit:
                 raise
-            # No cluster environment detected — running single-process.  Warn
-            # loudly: if the user expected a pod, silently degrading to
-            # world_size=1 would train N independent model copies.
+            hints = _cluster_env_hints()
+            if hints:
+                # The environment says this is one process of a pod job; a
+                # soft fallback here would train N independent model copies.
+                raise RuntimeError(
+                    "GSPMDBackend: jax.distributed.initialize failed "
+                    f"({e!r}) but cluster environment hints are present "
+                    f"({', '.join(hints)}) — refusing to fall back to "
+                    "single-process. Pass --coordinator_address/"
+                    "--num_processes/--process_id explicitly or fix the "
+                    "cluster rendezvous."
+                ) from e
+            # Truly no cluster environment — running single-process.  Still
+            # warn: if the user expected a pod, they should know.
             import warnings
 
             warnings.warn(
