@@ -69,7 +69,21 @@ class DALLEConfig:
     onehot_embed: bool = False  # loss-path embeds via one-hot matmul (MXU
     #                             backward instead of scatter-add); inference
     #                             forwards keep the gather
+    # MoE feed-forward (model hyperparameters — they change the param tree)
+    ff_experts: int = 0        # >1: MoE FF with this many experts
+    ff_expert_top_k: int = 2
+    ff_aux_weight: float = 0.01  # load-balance aux loss weight in training
+    # Sequence-parallel execution plan (NOT model hyperparameters: the param
+    # tree and the function are identical to the dense model; these only
+    # select manual collectives inside a shard_map.  Excluded from to_dict
+    # so checkpoints stay topology-free.)
+    ring_axis: Optional[str] = None  # mesh axis name, e.g. "sp"
+    sp_impl: str = "ring"            # 'ring' | 'ulysses'
+    sp_size: int = 1                 # ways of the sp axis (static shard count)
     dtype: Any = jnp.float32
+
+    # execution-plan fields stripped from checkpoint hparams (like dtype)
+    _PLAN_FIELDS = ("ring_axis", "sp_impl", "sp_size")
 
     @property
     def image_seq_len(self) -> int:
@@ -91,13 +105,16 @@ class DALLEConfig:
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d.pop("dtype")
+        for f in self._PLAN_FIELDS:  # run topology, not model identity
+            d.pop(f)
         if d.get("attn_types") is not None:
             d["attn_types"] = list(d["attn_types"])
         return d
 
     @classmethod
     def from_dict(cls, d: dict, **overrides) -> "DALLEConfig":
-        d = dict(d)
+        d = {k: v for k, v in d.items()
+             if k not in cls._PLAN_FIELDS}  # tolerate old ckpts carrying them
         if d.get("attn_types") is not None:
             d["attn_types"] = tuple(d["attn_types"])
         d.update(overrides)
@@ -164,17 +181,35 @@ class AxialPositionalEmbedding(nn.Module):
         return grid[:n]
 
 
+def transformer_kwargs(cfg: DALLEConfig) -> dict:
+    """The Transformer construction kwargs DALLE uses — exposed so the
+    pipeline-parallel trainer can build the identical stage module
+    (parallel/pipeline.py) without duplicating this mapping."""
+    attn_types = cfg.attn_types
+    if attn_types is None:
+        # the reference's `sparse_attn` flag selected DeepSpeed's kernel
+        # upstream (attention.py:284-342); here it selects the
+        # block-sparse pattern for every layer.
+        attn_types = ("sparse",) if cfg.sparse_attn else ("full",)
+    return dict(
+        dim=cfg.dim, depth=cfg.depth, seq_len=cfg.seq_len, causal=True,
+        heads=cfg.heads, dim_head=cfg.dim_head,
+        attn_dropout=cfg.attn_dropout, ff_dropout=cfg.ff_dropout,
+        attn_types=tuple(attn_types), image_fmap_size=cfg.image_fmap_size,
+        text_len=cfg.text_seq_len + 1, reversible=cfg.reversible,
+        use_remat=cfg.use_remat, use_pallas=cfg.use_pallas,
+        pallas_block_q=cfg.pallas_block_q,
+        pallas_block_k=cfg.pallas_block_k,
+        ring_axis=cfg.ring_axis, sp_impl=cfg.sp_impl,
+        ff_experts=cfg.ff_experts, ff_expert_top_k=cfg.ff_expert_top_k,
+        dtype=cfg.dtype)
+
+
 class DALLE(nn.Module):
     cfg: DALLEConfig
 
     def setup(self):
         cfg = self.cfg
-        attn_types = cfg.attn_types
-        if attn_types is None:
-            # the reference's `sparse_attn` flag selected DeepSpeed's kernel
-            # upstream (attention.py:284-342); here it selects the
-            # block-sparse pattern for every layer.
-            attn_types = ("sparse",) if cfg.sparse_attn else ("full",)
         self.text_emb = nn.Embed(cfg.total_text_tokens, cfg.dim,
                                  embedding_init=nn.initializers.normal(1.0),
                                  name="text_emb")
@@ -186,16 +221,8 @@ class DALLE(nn.Module):
                                      name="text_pos_emb")
         self.image_pos_emb = AxialPositionalEmbedding(
             cfg.dim, cfg.image_fmap_size, name="image_pos_emb")
-        self.transformer = Transformer(
-            dim=cfg.dim, depth=cfg.depth, seq_len=cfg.seq_len, causal=True,
-            heads=cfg.heads, dim_head=cfg.dim_head,
-            attn_dropout=cfg.attn_dropout, ff_dropout=cfg.ff_dropout,
-            attn_types=tuple(attn_types), image_fmap_size=cfg.image_fmap_size,
-            text_len=cfg.text_seq_len + 1, reversible=cfg.reversible,
-            use_remat=cfg.use_remat, use_pallas=cfg.use_pallas,
-            pallas_block_q=cfg.pallas_block_q,
-            pallas_block_k=cfg.pallas_block_k,
-            dtype=cfg.dtype, name="transformer")
+        self.transformer = Transformer(name="transformer",
+                                       **transformer_kwargs(cfg))
         self.final_norm = nn.LayerNorm(dtype=jnp.float32, name="final_norm")
         self.to_logits_dense = PhaseLogits(cfg.total_text_tokens,
                                            cfg.total_tokens,
@@ -265,32 +292,27 @@ class DALLE(nn.Module):
 
     # --- main forward (ref :428-500) ---
 
-    def __call__(self, text, image_codes=None, mask=None, return_loss: bool = False,
-                 deterministic: bool = True):
+    def embed_sequence(self, text, image_codes=None, onehot: bool = False):
+        """[bos+text | image] token embeddings, truncated to seq_len (ref
+        :440-475) — the input to the transformer stack.  Exposed as a
+        method so the pipeline-parallel trainer can run embeddings outside
+        the pipelined stack (training.py::make_dalle_pp_train_step)."""
         cfg = self.cfg
-        # one-hot embeds only pay off through their backward — inference
-        # forwards (return_loss=False, prefill, decode) keep the gather
-        onehot = cfg.onehot_embed and return_loss
         tokens = self._embed_text(text, onehot)
-
         if image_codes is not None and image_codes.shape[1] > 0:
             image_emb = self._embed_image_codes(image_codes, onehot)
             tokens = jnp.concatenate([tokens, image_emb], axis=1)
-
         # drop the final token when the sequence overflows (ref :473-475)
         if tokens.shape[1] > cfg.seq_len:
             tokens = tokens[:, : cfg.seq_len]
-        n = tokens.shape[1]
+        return tokens
 
-        out = self.transformer(tokens, mask=self._pad_mask_for_bos(mask),
-                               deterministic=deterministic)
+    def loss_from_hidden(self, out, text, image_codes):
+        """final-norm + logits head + phase-sliced CE over full-sequence
+        transformer output ``out`` [b, n, d] (the second half of the dense
+        training forward; also the pipeline trainer's exit path)."""
+        cfg = self.cfg
         logits = self.to_logits_dense(self.final_norm(out.astype(jnp.float32)))
-
-        if not return_loss:
-            return jnp.where(self._logits_mask(n)[None],
-                             max_neg_value(logits.dtype), logits)
-
-        assert image_codes is not None, "when training, image codes must be supplied"
         # Phase-sliced cross-entropy: text positions normalize over the text
         # vocab, image positions over the image vocab.  Identical to the
         # reference's masked-logits softmax (ref :482-499 — masked entries
@@ -310,6 +332,83 @@ class DALLE(nn.Module):
                              self._remap_pad_tokens(text))
         loss_img = phase_ce(logits[:, T:, V_text:], image_codes)
         return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)
+
+    def _sp_loss(self, text, image_codes, onehot: bool, deterministic: bool):
+        """Sequence-parallel training loss — runs INSIDE a shard_map over
+        ``cfg.ring_axis`` (training.py::make_dalle_sp_train_step).
+
+        Embeddings are computed on the full sequence (cheap: gathers + adds)
+        and the local shard sliced off; the transformer — where the FLOPs
+        are — sees only ``seq_len / sp_size`` positions per device, with
+        ring/Ulysses collectives making attention exact.  The phase CE is
+        computed per local position against its *global* phase and label,
+        then psum'd, reproducing the dense loss exactly.
+        """
+        cfg = self.cfg
+        S = cfg.sp_size
+        tokens = self.embed_sequence(text, image_codes, onehot)
+        n = tokens.shape[1]
+        assert n % S == 0, f"seq_len {n} not divisible by sp_size {S}"
+        L = n // S
+        idx = jax.lax.axis_index(cfg.ring_axis)
+        x = jax.lax.dynamic_slice_in_dim(tokens, idx * L, L, axis=1)
+
+        out = self.transformer(x, deterministic=deterministic)
+        logits = self.to_logits_dense(
+            self.final_norm(out.astype(jnp.float32)))  # [b, L, total_tokens]
+
+        T, V_text = cfg.text_seq_len, cfg.total_text_tokens
+        pos = idx * L + jnp.arange(L)          # global positions of my shard
+        is_text = pos < T
+        text_labels = self._remap_pad_tokens(text)
+        lab_t = jnp.take(text_labels, jnp.clip(pos, 0, T - 1), axis=1)
+        lab_i = jnp.take(image_codes,
+                         jnp.clip(pos - T, 0, image_codes.shape[1] - 1), axis=1)
+
+        def phase_ce_sum(phase_logits, labels, sel):
+            lse = jax.nn.logsumexp(phase_logits, axis=-1)
+            ll = jnp.take_along_axis(
+                phase_logits, labels[:, :, None], axis=-1)[..., 0]
+            return jnp.where(sel[None, :], lse - ll, 0.0).sum()
+
+        b = text.shape[0]
+        sum_t = jax.lax.psum(
+            phase_ce_sum(logits[..., :V_text], lab_t, is_text), cfg.ring_axis)
+        sum_i = jax.lax.psum(
+            phase_ce_sum(logits[..., V_text:], lab_i, ~is_text), cfg.ring_axis)
+        loss_text = sum_t / (b * T)
+        loss_img = sum_i / (b * cfg.image_seq_len)
+        return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)
+
+    def __call__(self, text, image_codes=None, mask=None, return_loss: bool = False,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        # one-hot embeds only pay off through their backward — inference
+        # forwards (return_loss=False, prefill, decode) keep the gather
+        onehot = cfg.onehot_embed and return_loss
+
+        if return_loss and cfg.ring_axis is not None and cfg.sp_size > 1 \
+                and not self.is_initializing():
+            assert image_codes is not None, (
+                "when training, image codes must be supplied")
+            assert mask is None, (
+                "sequence-parallel training does not take a key padding mask")
+            return self._sp_loss(text, image_codes, onehot, deterministic)
+
+        tokens = self.embed_sequence(text, image_codes, onehot)
+        n = tokens.shape[1]
+
+        out = self.transformer(tokens, mask=self._pad_mask_for_bos(mask),
+                               deterministic=deterministic)
+
+        if not return_loss:
+            logits = self.to_logits_dense(
+                self.final_norm(out.astype(jnp.float32)))
+            return jnp.where(self._logits_mask(n)[None],
+                             max_neg_value(logits.dtype), logits)
+
+        assert image_codes is not None, "when training, image codes must be supplied"
+        return self.loss_from_hidden(out, text, image_codes)
 
     # --- generation (prefill + decode; ref generate_images :370-426) ---
 

@@ -266,11 +266,14 @@ class MultiHeadAttention(nn.Module):
         b, n, _ = x.shape
         q, k, v = self._qkv(x)
 
-        if self.ring_axis is not None:
+        if self.ring_axis is not None and not self.is_initializing():
             # sequence parallelism: x is this device's sequence shard and we
             # are inside a shard_map over `ring_axis` — exact attention via
             # k/v ring rotation (parallel/ring.py) or head<->sequence
-            # all-to-all (parallel/ulysses.py)
+            # all-to-all (parallel/ulysses.py).  During flax init there is
+            # no shard_map (the axis name is unbound), so init falls through
+            # to dense attention — the param tree is identical either way,
+            # which is what lets sp checkpoints stay topology-free.
             assert mask is None, (
                 "sequence-parallel attention does not take a key padding "
                 "mask; fold it into the token stream instead")

@@ -49,7 +49,7 @@ DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
 
 
 def make_mesh(dp: Optional[int] = None, fsdp: int = 1, tp: int = 1,
-              devices=None, dcn_dp: int = 1) -> Mesh:
+              devices=None, dcn_dp: int = 1, sp: int = 1, pp: int = 1) -> Mesh:
     """Build a ('dp','fsdp','tp') mesh.  `dp=None` absorbs remaining devices.
 
     ``dcn_dp > 1`` targets multi-slice topologies (TPU pods joined over the
@@ -58,9 +58,25 @@ def make_mesh(dp: Optional[int] = None, fsdp: int = 1, tp: int = 1,
     reduce inside each slice over ICI first and only the per-slice partials
     cross DCN, while fsdp/tp collectives stay entirely on ICI.  ``dp`` counts
     the *total* data-parallel ways (ICI ways x dcn_dp).
+
+    ``sp > 1`` / ``pp > 1`` instead build a ('dp','sp') or ('dp','pp') mesh
+    for sequence-parallel (ring/Ulysses shard_map) or pipeline-parallel
+    (GPipe shard_map) training — those strategies own their inner axis via
+    manual collectives, so they are mutually exclusive with each other and
+    with fsdp/tp/dcn_dp in one mesh.
     """
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
+    if sp > 1 or pp > 1:
+        inner_name, inner = ("sp", sp) if sp > 1 else ("pp", pp)
+        assert sp == 1 or pp == 1, "sp and pp are mutually exclusive"
+        assert fsdp == 1 and tp == 1 and dcn_dp == 1, (
+            f"{inner_name} cannot be combined with fsdp/tp/dcn_dp in one mesh")
+        assert n % inner == 0, f"{n} devices not divisible by {inner_name}={inner}"
+        if dp is None:
+            dp = n // inner
+        assert dp * inner == n, f"mesh {dp}x{inner} != {n} devices"
+        return Mesh(np.asarray(devices).reshape(dp, inner), ("dp", inner_name))
     if dp is None:
         assert n % (fsdp * tp) == 0, f"{n} devices not divisible by fsdp*tp={fsdp * tp}"
         dp = n // (fsdp * tp)
@@ -92,8 +108,9 @@ def _path_str(path) -> str:
 
 
 def _prune_spec(spec: P, mesh: Mesh, shape) -> P:
-    """Drop axes of size 1 and axes that don't divide the dim — keeps rules
-    valid on any mesh (e.g. pure-dp) without per-mesh rule sets."""
+    """Drop axes of size 1, axes absent from the mesh (sp/pp meshes carry
+    no fsdp/tp), and axes that don't divide the dim — keeps rules valid on
+    any mesh (e.g. pure-dp) without per-mesh rule sets."""
     out = []
     for dim, names in enumerate(spec):
         if names is None:
@@ -102,8 +119,9 @@ def _prune_spec(spec: P, mesh: Mesh, shape) -> P:
         names_t = (names,) if isinstance(names, str) else tuple(names)
         size = 1
         for nm in names_t:
-            size *= mesh.shape[nm]
-        if size == 1 or dim >= len(shape) or shape[dim] % size != 0:
+            size *= mesh.shape.get(nm, 1)
+        missing = any(nm not in mesh.shape for nm in names_t)
+        if missing or size == 1 or dim >= len(shape) or shape[dim] % size != 0:
             out.append(None)
         else:
             out.append(names if isinstance(names, str) else names_t)
@@ -118,7 +136,8 @@ class Partitioner:
                  batch_axes=("dp", "fsdp")):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.rules = [(re.compile(pat), spec) for pat, spec in rules]
-        self.batch_axes = tuple(batch_axes)
+        # drop batch axes the mesh doesn't have (sp/pp meshes carry no fsdp)
+        self.batch_axes = tuple(a for a in batch_axes if a in self.mesh.shape)
         self.batch_spec = P(self.batch_axes)
         self.data_sharding = NamedSharding(self.mesh, self.batch_spec)
         self.repl_sharding = NamedSharding(self.mesh, P())

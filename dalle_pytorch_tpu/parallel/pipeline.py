@@ -58,6 +58,24 @@ def stack_stage_params(params: dict, depth: int, pp: int,
     return out
 
 
+def unstack_stage_params(stacked: dict, depth: int, pp: int,
+                         layer_prefixes: tuple = ("layers_{i}_attn",
+                                                  "layers_{i}_ff")) -> dict:
+    """Inverse of :func:`stack_stage_params`: stage-stacked leaves (leading
+    ``pp`` axis) back to the flat ``layers_{i}_*`` tree — for writing
+    standard checkpoints and running the (non-pipelined) sampler."""
+    assert depth % pp == 0, f"depth {depth} not divisible by pp {pp}"
+    per = depth // pp
+    out: dict = {}
+    for local in range(per):
+        for prefix in layer_prefixes:
+            stacked_leaf = stacked[prefix.format(i=local)]
+            for stage in range(pp):
+                out[prefix.format(i=stage * per + local)] = jax.tree.map(
+                    lambda leaf, s=stage: leaf[s], stacked_leaf)
+    return out
+
+
 def pipeline_apply(stage_fn: Callable, stacked_params, x, *,
                    mesh: Mesh, pp_axis: str = "pp",
                    num_microbatches: int, remat: bool = True,

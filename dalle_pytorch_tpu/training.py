@@ -61,6 +61,20 @@ def make_vae_train_step(vae, tx, donate: bool = True):
     return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
 
 
+def _dalle_loss(dalle, params, text, codes, rng):
+    """Training loss incl. the MoE load-balance aux when the model routes
+    its FFs through experts (the sown 'losses' collection would silently
+    vanish without mutable=['losses'])."""
+    if getattr(dalle.cfg, "ff_experts", 0) > 1:
+        loss, state = dalle.apply(
+            {"params": params}, text, codes, return_loss=True,
+            deterministic=False, rngs={"dropout": rng}, mutable=["losses"])
+        aux = sum(jax.tree.leaves(state["losses"]))
+        return loss + dalle.cfg.ff_aux_weight * aux
+    return dalle.apply({"params": params}, text, codes, return_loss=True,
+                       deterministic=False, rngs={"dropout": rng})
+
+
 def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
                           jit: bool = True):
     """DALLE step.  If `vae` is given, batches carry raw images and the
@@ -80,12 +94,8 @@ def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
         else:
             codes = images_or_codes
 
-        def loss_fn(p):
-            return dalle.apply({"params": p}, text, codes, return_loss=True,
-                               deterministic=False,
-                               rngs={"dropout": rng})
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss, grads = jax.value_and_grad(
+            lambda p: _dalle_loss(dalle, p, text, codes, rng))(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -93,6 +103,110 @@ def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
     if not jit:
         return train_step
     return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_dalle_sp_train_step(dalle, tx, mesh, dp_axis: str = "dp",
+                             donate: bool = True):
+    """Sequence-parallel DALLE step: the loss runs inside a ``shard_map``
+    over (dp, sp) — batch sharded over ``dp_axis``, the sequence over
+    ``cfg.ring_axis`` with ring/Ulysses collectives making attention exact
+    (parallel/ring.py, parallel/ulysses.py), params replicated.  Output-
+    equivalent to the dense step (DALLE._sp_loss psums the per-shard phase
+    CE against global positions); the backward differentiates straight
+    through the shard_map (ppermute/all-to-all have transpose rules).
+
+    The reference's only strategy is DP (SURVEY.md §2.2); this is how the
+    framework trains sequences a single chip's HBM can't hold.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    cfg = dalle.cfg
+    axis = cfg.ring_axis
+    assert axis is not None and cfg.sp_size > 1, (
+        "sequence-parallel step needs cfg.ring_axis + cfg.sp_size > 1 "
+        "(set DALLEConfig(ring_axis='sp', sp_size=N))")
+    assert axis in mesh.axis_names and mesh.shape[axis] == cfg.sp_size, (
+        f"mesh axis {axis!r} of size {cfg.sp_size} required, "
+        f"got mesh {dict(mesh.shape)}")
+    assert cfg.ff_experts <= 1, (
+        "combining MoE with sequence parallelism is not supported")
+
+    def global_loss(params, text, codes, rng):
+        def local(params, text, codes, rng):
+            # decorrelate dropout across sequence shards (same key + same
+            # local shape would otherwise draw identical masks per shard)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+            loss = dalle.apply({"params": params}, text, codes,
+                               return_loss=True, deterministic=False,
+                               rngs={"dropout": rng})
+            return jax.lax.pmean(loss, dp_axis)
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(dp_axis), P(dp_axis), P()),
+            out_specs=P(), check_vma=False)(params, text, codes, rng)
+
+    def train_step(params, opt_state, _vae_params, text, codes, rng):
+        loss, grads = jax.value_and_grad(global_loss)(params, text, codes, rng)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_dalle_pp_train_step(dalle, tx, params, mesh, *,
+                             num_microbatches: int, pp_axis: str = "pp",
+                             dp_axis: str = "dp", donate: bool = True):
+    """Pipeline-parallel DALLE step (GPipe schedule, parallel/pipeline.py).
+
+    The transformer stack — where the params and FLOPs are — is cut into
+    ``mesh.shape[pp_axis]`` stages; embeddings and the logits head run
+    replicated outside the pipeline (they are a few percent of the work).
+    Returns ``(train_step, pp_params)`` where ``pp_params`` is the
+    restructured tree ``{'outer': <non-transformer params>, 'stages':
+    <stage-stacked transformer params>}`` the step trains on; convert back
+    with :func:`pp_params_to_dense` for checkpoints/sampling.
+    """
+    from .models.dalle import DALLE, transformer_kwargs
+    from .ops.transformer import Transformer
+    from .parallel.pipeline import pipeline_transformer
+
+    cfg = dalle.cfg
+    tf = Transformer(**transformer_kwargs(cfg))
+    _, stacked, apply_fn = pipeline_transformer(
+        tf, params["transformer"], mesh=mesh, pp_axis=pp_axis,
+        num_microbatches=num_microbatches, dp_axis=dp_axis)
+    pp_params = {"outer": {k: v for k, v in params.items()
+                           if k != "transformer"},
+                 "stages": stacked}
+
+    def loss_fn(p, text, codes):
+        tokens = dalle.apply({"params": p["outer"]}, text, codes,
+                             cfg.onehot_embed, method=DALLE.embed_sequence)
+        h = apply_fn(p["stages"], tokens)
+        return dalle.apply({"params": p["outer"]}, h, text, codes,
+                           method=DALLE.loss_from_hidden)
+
+    def train_step(pp_params, opt_state, _vae_params, text, codes, _rng):
+        loss, grads = jax.value_and_grad(loss_fn)(pp_params, text, codes)
+        updates, opt_state = tx.update(grads, opt_state, pp_params)
+        pp_params = optax.apply_updates(pp_params, updates)
+        return pp_params, opt_state, loss
+
+    return (jax.jit(train_step, donate_argnums=(0, 1) if donate else ()),
+            pp_params)
+
+
+def pp_params_to_dense(dalle, pp_params, mesh, pp_axis: str = "pp"):
+    """Invert the pipeline restructuring: ``{'outer', 'stages'}`` back to
+    the standard DALLE param tree (for checkpoints and the sampler)."""
+    from .parallel.pipeline import unstack_stage_params
+
+    dense = dict(pp_params["outer"])
+    dense["transformer"] = unstack_stage_params(
+        pp_params["stages"], dalle.cfg.depth, mesh.shape[pp_axis])
+    return dense
 
 
 def make_clip_train_step(clip, tx, donate: bool = True):

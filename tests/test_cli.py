@@ -163,6 +163,95 @@ def test_train_dalle_resume(trained_dalle, tiny_dataset, tiny_tokenizer_json,
     assert int(ckpt["epoch"]) == 2
 
 
+def _run_train_dalle(workdir, hparams, extra_args, vae_path, dataset,
+                     tokenizer_json):
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps(hparams)
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        import train_dalle
+
+        train_dalle.main(["--vae_path", str(vae_path),
+                          "--image_text_folder", str(dataset),
+                          "--bpe_path", str(tokenizer_json),
+                          "--truncate_captions",
+                          "--learning_rate", "1e-3",
+                          "--epochs", "1"] + extra_args)
+    finally:
+        os.chdir(cwd)
+        del os.environ["DALLE_TPU_HPARAMS"]
+
+
+def _first_loss(workdir):
+    logs = sorted(workdir.glob("dalle_tpu_train_transformer-*.txt"),
+                  key=lambda p: p.stat().st_mtime)
+    return float(logs[-1].read_text().strip().split("\n")[0].split(" ")[2])
+
+
+@pytest.mark.parametrize("sp_impl,sp", [("ring", 4), ("ulysses", 2)])
+def test_train_dalle_sequence_parallel_cli(trained_vae, tiny_dataset,
+                                           tiny_tokenizer_json,
+                                           tmp_path_factory, sp_impl, sp):
+    """`train_dalle.py --mesh_sp N` trains on the 8-CPU mesh and its
+    first-step loss matches a dense run bit-for-bit-ish (the sp loss psums
+    the identical phase CE; VERDICT round-1 item 3)."""
+    wd_dense = tmp_path_factory.mktemp(f"sp_dense_{sp_impl}")
+    wd_sp = tmp_path_factory.mktemp(f"sp_{sp_impl}")
+    # seq_len = 8 text + 16 image = 24, divisible by sp 4 and 2.
+    # crop ratio 1.0 => deterministic augmentation, so the dense run is an
+    # exact reference (the crop rng is otherwise thread-schedule dependent)
+    det = ["--random_resize_crop_lower_ratio", "1.0"]
+    hp = dict(DALLE_HPARAMS, BATCH_SIZE=4, DEPTH=2)
+    _run_train_dalle(wd_dense, hp, det, trained_vae, tiny_dataset,
+                     tiny_tokenizer_json)
+    _run_train_dalle(wd_sp, hp,
+                     det + ["--mesh_sp", str(sp), "--sp_impl", sp_impl],
+                     trained_vae, tiny_dataset, tiny_tokenizer_json)
+    assert (wd_sp / "dalle-final.pt").exists()
+    # same data order (seeded shuffle), same init seed -> same first loss
+    assert abs(_first_loss(wd_dense) - _first_loss(wd_sp)) < 2e-4
+    # the sp checkpoint is topology-free: no plan fields in hparams
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    hparams = dict(load_checkpoint(wd_sp / "dalle-final.pt")["hparams"])
+    assert "ring_axis" not in hparams and "sp_size" not in hparams
+
+
+def test_train_dalle_pipeline_cli(trained_vae, tiny_dataset,
+                                  tiny_tokenizer_json, tmp_path_factory):
+    """`train_dalle.py --pipeline_stages 2` trains on the 8-CPU mesh; the
+    saved checkpoint carries the standard dense param layout."""
+    wd = tmp_path_factory.mktemp("pp_cli")
+    hp = dict(DALLE_HPARAMS, BATCH_SIZE=8, DEPTH=4)
+    _run_train_dalle(wd, hp, ["--pipeline_stages", "2",
+                              "--pipeline_microbatches", "2"],
+                     trained_vae, tiny_dataset, tiny_tokenizer_json)
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    ckpt = load_checkpoint(wd / "dalle-final.pt")
+    assert "layers_3_ff" in ckpt["weights"]["transformer"]  # dense layout
+    assert "opt_state" not in ckpt  # weights-only in pp mode (documented)
+    assert np.isfinite(_first_loss(wd))
+
+
+def test_train_dalle_moe_cli(trained_vae, tiny_dataset, tiny_tokenizer_json,
+                             tmp_path_factory):
+    """`train_dalle.py --ff_experts 2` trains routed-MoE feed-forwards and
+    records the expert count in the checkpoint hparams (a model
+    hyperparameter, unlike the sp/pp execution plan)."""
+    wd = tmp_path_factory.mktemp("moe_cli")
+    hp = dict(DALLE_HPARAMS, BATCH_SIZE=4, DEPTH=2)
+    _run_train_dalle(wd, hp, ["--ff_experts", "2", "--ff_expert_top_k", "1"],
+                     trained_vae, tiny_dataset, tiny_tokenizer_json)
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    ckpt = load_checkpoint(wd / "dalle-final.pt")
+    assert ckpt["hparams"]["ff_experts"] == 2
+    ff = ckpt["weights"]["transformer"]["layers_0_ff"]
+    assert "moe" in ff and ff["moe"]["w_in"].shape[0] == 2
+    assert np.isfinite(_first_loss(wd))
+
+
 def test_generate_cli(trained_dalle, tiny_tokenizer_json, workdir):
     cwd = os.getcwd()
     os.chdir(workdir)

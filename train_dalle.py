@@ -92,10 +92,46 @@ def parse_args(argv=None):
                              '({name}.orbax) with per-host shard IO instead '
                              'of gathering to process 0 (for multi-host '
                              'scale); load sites accept both formats')
+    parser.add_argument('--mesh_sp', type=int, default=1,
+                        help='sequence-parallel ways: shard the sequence '
+                             'over an sp mesh axis with exact ring/Ulysses '
+                             'attention (long-context training; seq_len must '
+                             'divide by this)')
+    parser.add_argument('--sp_impl', choices=('ring', 'ulysses'),
+                        default='ring',
+                        help='sequence-parallel scheme: ring (k/v rotation) '
+                             'or ulysses (head<->sequence all-to-all; needs '
+                             'heads %% mesh_sp == 0)')
+    parser.add_argument('--pipeline_stages', type=int, default=1,
+                        help='pipeline-parallel stages (GPipe schedule): '
+                             'depth must divide by this and each stage must '
+                             'hold whole attn-type cycles. Checkpoints are '
+                             'saved weights-only in this mode (optimizer '
+                             'moments are stage-stacked)')
+    parser.add_argument('--pipeline_microbatches', type=int, default=4,
+                        help='GPipe microbatches per step (batch_size must '
+                             'divide by this)')
+    parser.add_argument('--ff_experts', type=int, default=0,
+                        help='>1: replace feed-forwards with top-k routed '
+                             'MoE layers of this many experts (a model '
+                             'hyperparameter — stored in checkpoints)')
+    parser.add_argument('--ff_expert_top_k', type=int, default=2,
+                        help='experts routed per token when --ff_experts > 1')
     parser = distributed_utils.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
     if args.stall_timeout and not args.heartbeat_dir:
         parser.error('--stall_timeout requires --heartbeat_dir')
+    if args.mesh_sp > 1 and args.pipeline_stages > 1:
+        parser.error('--mesh_sp and --pipeline_stages are mutually exclusive')
+    if (args.mesh_sp > 1 or args.pipeline_stages > 1) and (
+            args.mesh_fsdp > 1 or args.mesh_tp > 1 or args.mesh_dcn_dp > 1):
+        parser.error('--mesh_sp/--pipeline_stages own the non-dp mesh axis; '
+                     'combine with --mesh_fsdp/--mesh_tp/--mesh_dcn_dp is '
+                     'not supported')
+    if args.ff_experts > 1 and args.mesh_sp > 1:
+        parser.error('--ff_experts with --mesh_sp is not supported')
+    if args.ff_experts > 1 and args.pipeline_stages > 1:
+        parser.error('--ff_experts with --pipeline_stages is not supported')
     return args
 
 
@@ -179,6 +215,14 @@ def main(argv=None):
     distr_backend.initialize()
     distr_backend.check_batch_size(BATCH_SIZE)
 
+    # execution-plan config overrides (NOT stored in checkpoints): the model
+    # function is identical to dense, only the collectives differ
+    sp_plan = {}
+    if args.mesh_sp > 1:
+        sp_plan = dict(ring_axis='sp', sp_impl=args.sp_impl,
+                       sp_size=args.mesh_sp)
+    pp_mode = args.pipeline_stages > 1
+
     tokenizer = select_tokenizer(args.bpe_path, chinese=args.chinese)
     dtype = jnp.bfloat16 if args.fp16 else jnp.float32
 
@@ -212,7 +256,8 @@ def main(argv=None):
         if (vae_weights is None and resume_sharded is None
                 and resume_ckpt.get('vae_weights') is not None):
             vae_weights = resume_ckpt['vae_weights']
-        dalle_cfg = DALLEConfig.from_dict(dict(resume_ckpt['hparams']), dtype=dtype)
+        dalle_cfg = DALLEConfig.from_dict(dict(resume_ckpt['hparams']),
+                                          dtype=dtype, **sp_plan)
         # the checkpoint's geometry wins over the script constants — a resume
         # of a non-default run must rebuild the exact model (ref :116-133)
         TEXT_SEQ_LEN = dalle_cfg.text_seq_len
@@ -230,9 +275,17 @@ def main(argv=None):
             reversible=REVERSIBLE,
             loss_img_weight=LOSS_IMG_WEIGHT,
             attn_types=ATTN_TYPES,
+            ff_experts=args.ff_experts,
+            ff_expert_top_k=args.ff_expert_top_k,
             dtype=dtype,
+            **sp_plan,
         )
     dalle = DALLE(dalle_cfg)
+    # dense twin: identical param tree, no sp collectives — used for init
+    # (which runs the forward outside any shard_map) and for sampling
+    import dataclasses as _dc
+    dalle_dense = (DALLE(_dc.replace(dalle_cfg, ring_axis=None, sp_size=1))
+                   if sp_plan else dalle)
 
     ds = TextImageDataset(
         args.image_text_folder, tokenizer, text_len=TEXT_SEQ_LEN,
@@ -251,21 +304,28 @@ def main(argv=None):
     rng, init_rng = jax.random.split(rng)
     dummy_text = jnp.zeros((1, TEXT_SEQ_LEN), jnp.int32)
     dummy_codes = jnp.zeros((1, dalle_cfg.image_seq_len), jnp.int32)
-    part = distr_backend.distribute()
+    if sp_plan or pp_mode:
+        from dalle_pytorch_tpu.parallel.mesh import make_mesh
+
+        part = distr_backend.distribute(mesh=make_mesh(
+            sp=args.mesh_sp, pp=args.pipeline_stages))
+    else:
+        part = distr_backend.distribute()
     if resume_sharded is not None:
         # no device allocation at all: phase 2 below restores straight onto
         # ShapeDtypeStruct templates, so an elastic resume never holds a
         # discarded random init alongside the restored arrays (that 2x peak
         # would bite exactly when resuming onto less hardware)
         param_shapes = jax.eval_shape(
-            lambda r: dalle.init(r, dummy_text, dummy_codes)['params'],
+            lambda r: dalle_dense.init(r, dummy_text, dummy_codes)['params'],
             init_rng)
         params = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
             param_shapes, part.param_shardings(param_shapes))
     else:
         params = jax.jit(
-            lambda r: dalle.init(r, dummy_text, dummy_codes)['params'])(init_rng)
+            lambda r: dalle_dense.init(r, dummy_text, dummy_codes)['params']
+        )(init_rng)
         if resume_ckpt is not None:
             from dalle_pytorch_tpu.utils.checkpoint import migrate_qkv_kernels
 
@@ -303,11 +363,41 @@ def main(argv=None):
         vae_params = None
 
     tx = make_optimizer(LEARNING_RATE, grad_clip_norm=GRAD_CLIP_NORM)
+
+    train_step_pp = None
+    if pp_mode:
+        assert resume_sharded is None, (
+            '--pipeline_stages resumes from msgpack checkpoints only (the '
+            'sharded two-phase restore targets the dense layout)')
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dalle_pytorch_tpu.training import make_dalle_pp_train_step
+
+        # restructure params {'outer', 'stages'} and place each stage's
+        # slice on its pipeline device (leading-axis 'pp' sharding)
+        train_step_pp, params = make_dalle_pp_train_step(
+            dalle, tx, params, part.mesh,
+            num_microbatches=args.pipeline_microbatches)
+        _stage_shard = NamedSharding(part.mesh, P('pp'))
+
+        def _pp_shard(path, leaf):
+            in_stages = any(getattr(k, 'key', None) == 'stages' for k in path)
+            return (_stage_shard if in_stages and getattr(leaf, 'ndim', 0) > 0
+                    else part.repl_sharding)
+
+        params = jax.device_put(
+            params, jax.tree_util.tree_map_with_path(_pp_shard, params))
+
     if resume_sharded is not None:
         # abstract init: params are ShapeDtypeStructs here, and the real
         # moments arrive from the checkpoint in phase 2 — allocating zeros
         # first would only raise the restore's peak memory
         opt_state = jax.eval_shape(tx.init, params)
+    elif pp_mode:
+        # Adam moments follow the stage-stacked layout
+        opt_sds = jax.eval_shape(tx.init, params)
+        opt_state = jax.jit(tx.init, out_shardings=jax.tree_util.
+                            tree_map_with_path(_pp_shard, opt_sds))(params)
     else:
         opt_state = part.init_opt_state(tx, params)
     if resume_sharded is not None:
@@ -358,6 +448,10 @@ def main(argv=None):
                            for l in jax.tree.leaves(vae_params)), (
                 f'{resume_sharded} carries no vae_weights but the run needs '
                 'a custom VAE — pass --vae_path for its weights')
+    elif resume_ckpt is not None and 'opt_state' in resume_ckpt and pp_mode:
+        if distr_backend.is_root_worker():
+            print('--pipeline_stages: checkpointed optimizer state targets '
+                  'the dense layout; continuing with fresh optimizer state')
     elif resume_ckpt is not None and 'opt_state' in resume_ckpt:
         def _fit_leaf(tmpl, v):
             if not hasattr(tmpl, 'dtype'):
@@ -374,7 +468,32 @@ def main(argv=None):
             opt_state, jax.tree.unflatten(jax.tree.structure(opt_state),
                                           jax.tree.leaves(resume_ckpt['opt_state'])))
 
-    if is_custom_vae:
+    if args.mesh_sp > 1 or pp_mode:
+        # sp/pp steps consume codes: the VAE encodes outside their
+        # shard_map'd loss (the codes feed is replicated/dp-sharded data)
+        if args.mesh_sp > 1:
+            from dalle_pytorch_tpu.training import make_dalle_sp_train_step
+
+            _codes_step = make_dalle_sp_train_step(dalle, tx, part.mesh)
+        else:
+            _codes_step = train_step_pp
+        if is_custom_vae:
+            encode_fn = jax.jit(lambda vp, imgs: vae.apply(
+                {'params': vp}, imgs,
+                method=DiscreteVAE.get_codebook_indices))
+
+            def train_step(params, opt_state, vae_params, text, images, rng):
+                # codes are concrete int32 outputs of a separate jit — no
+                # gradient path into the frozen VAE exists to stop
+                codes = encode_fn(vae_params, images)
+                return _codes_step(params, opt_state, None, text, codes, rng)
+        else:
+            encode_fn = jax.jit(vae.get_codebook_indices)
+
+            def train_step(params, opt_state, _vae_params, text, images, rng):
+                return _codes_step(params, opt_state, None, text,
+                                   encode_fn(images), rng)
+    elif is_custom_vae:
         # frozen DiscreteVAE tokenizes images inside the jitted step
         train_step = make_dalle_train_step(dalle, tx, vae=vae)
     else:
@@ -407,6 +526,15 @@ def main(argv=None):
                              method=DiscreteVAE.decode)
         return vae.decode(codes)
 
+    def dense_params_view():
+        """The standard DALLE param tree, whatever layout training uses —
+        checkpoints and the sampler always see the dense structure."""
+        if pp_mode:
+            from dalle_pytorch_tpu.training import pp_params_to_dense
+
+            return pp_params_to_dense(dalle, params, part.mesh)
+        return params
+
     def save_model(path, epoch):
         if args.sharded_checkpoints:
             # Orbax writes each host's shards directly — no gather; every
@@ -417,11 +545,12 @@ def main(argv=None):
             payload = {
                 'hparams': dalle_cfg.to_dict(),
                 'vae_params': vae_hparams,
-                'weights': params,
-                'opt_state': jax.tree.leaves(opt_state),
+                'weights': dense_params_view(),
                 'scheduler': sched.state_dict(),
                 'epoch': epoch,
             }
+            if not pp_mode:  # pp moments are stage-stacked: weights-only
+                payload['opt_state'] = jax.tree.leaves(opt_state)
             if is_custom_vae and vae_params is not None:
                 payload['vae_weights'] = vae_params
             path = f'{path}.orbax'
@@ -429,8 +558,9 @@ def main(argv=None):
             return path
         # every process participates in the fetch (sharded params span
         # non-addressable devices multi-host); only root writes
-        weights = host_fetch(params)
-        opt_leaves = host_fetch(jax.tree.leaves(opt_state))
+        weights = host_fetch(dense_params_view())
+        opt_leaves = (None if pp_mode
+                      else host_fetch(jax.tree.leaves(opt_state)))
         vae_weights = (host_fetch(vae_params)
                        if is_custom_vae and vae_params is not None else None)
         if not distr_backend.is_root_worker():
@@ -439,10 +569,11 @@ def main(argv=None):
             'hparams': dalle_cfg.to_dict(),
             'vae_params': vae_hparams,  # None for pretrained VAEs (ref :167-172)
             'weights': weights,
-            'opt_state': opt_leaves,
             'scheduler': sched.state_dict(),
             'epoch': epoch,
         }
+        if opt_leaves is not None:
+            payload['opt_state'] = opt_leaves
         if vae_weights is not None:
             payload['vae_weights'] = vae_weights
         save_checkpoint(path, payload)
@@ -532,7 +663,8 @@ def main(argv=None):
                             sample_text = multihost_utils.broadcast_one_to_all(
                                 sample_text)
                         sample_text = jnp.asarray(sample_text)
-                        codes = generate_codes(dalle, {'params': params},
+                        codes = generate_codes(dalle_dense,
+                                               {'params': dense_params_view()},
                                                sample_text, gen_rng, filter_thres=0.9)
                         image = host_fetch(decode_images(vae_params, codes)[0])
                         if distr_backend.is_root_worker():
