@@ -212,13 +212,26 @@ class _VQAttnBlock(nn.Module):
     def __call__(self, x):
         b, h, w, c = x.shape
         hn = nn.GroupNorm(num_groups=32, name="norm")(x)
-        q = nn.Conv(c, (1, 1), name="q")(hn).reshape(b, h * w, c)
-        k = nn.Conv(c, (1, 1), name="k")(hn).reshape(b, h * w, c)
-        v = nn.Conv(c, (1, 1), name="v")(hn).reshape(b, h * w, c)
+        q = nn.Conv(c, (1, 1), dtype=self.dtype, name="q")(hn).reshape(b, h * w, c)
+        k = nn.Conv(c, (1, 1), dtype=self.dtype, name="k")(hn).reshape(b, h * w, c)
+        v = nn.Conv(c, (1, 1), dtype=self.dtype, name="v")(hn).reshape(b, h * w, c)
         attn = jax.nn.softmax(
             jnp.einsum("bic,bjc->bij", q, k) * (c ** -0.5), axis=-1)
         o = jnp.einsum("bij,bjc->bic", attn, v).reshape(b, h, w, c)
-        return x + nn.Conv(c, (1, 1), name="proj_out")(o)
+        return x + nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_out")(o)
+
+
+def vqgan_attn_levels(resolution: int, ch_mult: tuple,
+                      attn_resolutions: tuple) -> tuple:
+    """Encoder level indices that carry per-block AttnBlocks, following
+    taming's resolution bookkeeping: level i runs at resolution/2^i, and
+    levels whose resolution is in ``attn_resolutions`` interleave attention
+    after each res block.  The released f=16/1024 model
+    (`vqgan_imagenet_f16_1024`: resolution 256, attn_resolutions [16]) has
+    them at encoder level 4 / decoder's lowest level — a converter that
+    drops those keys would be silently wrong with the real weights."""
+    return tuple(i for i in range(len(ch_mult))
+                 if resolution // (2 ** i) in tuple(attn_resolutions))
 
 
 class VQGanEncoder(nn.Module):
@@ -226,15 +239,22 @@ class VQGanEncoder(nn.Module):
     ch_mult: tuple = (1, 1, 2, 2, 4)
     num_res_blocks: int = 2
     z_channels: int = 256
+    resolution: int = 256
+    attn_resolutions: tuple = (16,)
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
+        attn_levels = vqgan_attn_levels(self.resolution, self.ch_mult,
+                                        self.attn_resolutions)
         h = nn.Conv(self.ch, (3, 3), padding=1, dtype=self.dtype, name="conv_in")(x)
         for i, mult in enumerate(self.ch_mult):
             for b in range(self.num_res_blocks):
                 h = _VQResnetBlock(self.ch * mult, dtype=self.dtype,
                                    name=f"down_{i}_block_{b}")(h)
+                if i in attn_levels:
+                    h = _VQAttnBlock(dtype=self.dtype,
+                                     name=f"down_{i}_attn_{b}")(h)
             if i < len(self.ch_mult) - 1:
                 h = nn.Conv(self.ch * mult, (3, 3), strides=2, padding=((0, 1), (0, 1)),
                             dtype=self.dtype, name=f"down_{i}_downsample")(h)
@@ -252,10 +272,17 @@ class VQGanDecoder(nn.Module):
     ch_mult: tuple = (1, 1, 2, 2, 4)
     num_res_blocks: int = 2
     out_ch: int = 3
+    resolution: int = 256
+    attn_resolutions: tuple = (16,)
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, z):
+        # decoder runs levels highest-mult first; up_{i} here corresponds to
+        # taming's up.{n-1-i}, i.e. encoder level n-1-i and its resolution
+        attn_levels = vqgan_attn_levels(self.resolution, self.ch_mult,
+                                        self.attn_resolutions)
+        n = len(self.ch_mult)
         h = nn.Conv(self.ch * self.ch_mult[-1], (3, 3), padding=1,
                     dtype=self.dtype, name="conv_in")(z)
         h = _VQResnetBlock(self.ch * self.ch_mult[-1], dtype=self.dtype, name="mid_block_1")(h)
@@ -265,6 +292,9 @@ class VQGanDecoder(nn.Module):
             for b in range(self.num_res_blocks + 1):
                 h = _VQResnetBlock(self.ch * mult, dtype=self.dtype,
                                    name=f"up_{i}_block_{b}")(h)
+                if (n - 1 - i) in attn_levels:
+                    h = _VQAttnBlock(dtype=self.dtype,
+                                     name=f"up_{i}_attn_{b}")(h)
             if i < len(self.ch_mult) - 1:
                 bb, hh, ww, cc = h.shape
                 h = jax.image.resize(h, (bb, hh * 2, ww * 2, cc), "nearest")
@@ -305,8 +335,10 @@ class VQGanVAE1024:
             "encoder": enc["params"],
             "decoder": dec["params"],
             "codebook": jax.random.normal(k3, (self.num_tokens, self.embed_dim)) * 0.02,
-            "quant_proj": {"kernel": jnp.eye(self.embed_dim)},
-            "post_quant_proj": {"kernel": jnp.eye(self.embed_dim)},
+            "quant_proj": {"kernel": jnp.eye(self.embed_dim),
+                           "bias": jnp.zeros(self.embed_dim)},
+            "post_quant_proj": {"kernel": jnp.eye(self.embed_dim),
+                                "bias": jnp.zeros(self.embed_dim)},
         }
         return self.params
 
@@ -324,7 +356,8 @@ class VQGanVAE1024:
         input in [0,1], mapped to [-1,1] as taming expects."""
         self._require_params()
         z = self.encoder.apply({"params": self.params["encoder"]}, 2.0 * img - 1.0)
-        z = z @ self.params["quant_proj"]["kernel"]
+        z = z @ self.params["quant_proj"]["kernel"] + \
+            self.params["quant_proj"]["bias"]
         b, h, w, c = z.shape
         flat = z.reshape(-1, c)
         cb = self.params["codebook"]  # [num_tokens, c]
@@ -342,7 +375,8 @@ class VQGanVAE1024:
         b, n = img_seq.shape
         f = int(math.isqrt(n))
         z = jnp.take(self.params["codebook"], img_seq, axis=0).reshape(b, f, f, -1)
-        z = z @ self.params["post_quant_proj"]["kernel"]
+        z = z @ self.params["post_quant_proj"]["kernel"] + \
+            self.params["post_quant_proj"]["bias"]
         out = self.decoder.apply({"params": self.params["decoder"]}, z)
         return (jnp.clip(out, -1.0, 1.0) + 1.0) * 0.5
 

@@ -82,17 +82,21 @@ class _Holder(tnn.Module):
 
 
 class TVQEncoder(tnn.Module):
-    def __init__(self):
+    def __init__(self, attn_levels=()):
         super().__init__()
+        self.attn_levels = tuple(attn_levels)
         self.conv_in = tnn.Conv2d(3, CH, 3, padding=1)
         self.down = tnn.ModuleList()
         cin = CH
         for i, mult in enumerate(CH_MULT):
             lvl = _Holder()
             lvl.block = tnn.ModuleList()
+            lvl.attn = tnn.ModuleList()
             for _ in range(NRES):
                 lvl.block.append(TResBlock(cin, CH * mult))
                 cin = CH * mult
+                if i in self.attn_levels:
+                    lvl.attn.append(TAttnBlock(cin))
             if i < len(CH_MULT) - 1:
                 ds = _Holder()
                 ds.conv = tnn.Conv2d(cin, cin, 3, stride=2, padding=0)
@@ -109,8 +113,10 @@ class TVQEncoder(tnn.Module):
     def forward(self, x):
         h = self.conv_in(x)
         for i in range(len(CH_MULT)):
-            for blk in self.down[i].block:
+            for b, blk in enumerate(self.down[i].block):
                 h = blk(h)
+                if i in self.attn_levels:
+                    h = self.down[i].attn[b](h)
             if i < len(CH_MULT) - 1:
                 h = F.pad(h, (0, 1, 0, 1))  # taming's asymmetric pad
                 h = self.down[i].downsample.conv(h)
@@ -119,8 +125,9 @@ class TVQEncoder(tnn.Module):
 
 
 class TVQDecoder(tnn.Module):
-    def __init__(self):
+    def __init__(self, attn_levels=()):
         super().__init__()
+        self.attn_levels = tuple(attn_levels)
         cin = CH * CH_MULT[-1]
         self.conv_in = tnn.Conv2d(Z, cin, 3, padding=1)
         self.mid = _Holder()
@@ -138,9 +145,12 @@ class TVQDecoder(tnn.Module):
         for lvl_idx, mult in reversed(levels):
             lvl = _Holder()
             lvl.block = tnn.ModuleList()
+            lvl.attn = tnn.ModuleList()
             for _ in range(NRES + 1):
                 lvl.block.append(TResBlock(cin, CH * mult))
                 cin = CH * mult
+                if lvl_idx in self.attn_levels:
+                    lvl.attn.append(TAttnBlock(cin))
             if lvl_idx > 0:
                 us = _Holder()
                 us.conv = tnn.Conv2d(cin, cin, 3, padding=1)
@@ -155,8 +165,10 @@ class TVQDecoder(tnn.Module):
         h = self.conv_in(z)
         h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
         for lvl_idx in reversed(range(len(CH_MULT))):
-            for blk in self.up[lvl_idx].block:
+            for b, blk in enumerate(self.up[lvl_idx].block):
                 h = blk(h)
+                if lvl_idx in self.attn_levels:
+                    h = self.up[lvl_idx].attn[b](h)
             if lvl_idx > 0:
                 h = F.interpolate(h, scale_factor=2.0, mode="nearest")
                 h = self.up[lvl_idx].upsample.conv(h)
@@ -171,12 +183,19 @@ def _nhwc(t):
     return np.transpose(t.detach().numpy(), (0, 2, 3, 1))
 
 
-def test_vqgan_encoder_decoder_conversion():
+@pytest.mark.parametrize("with_attn", [False, True])
+def test_vqgan_encoder_decoder_conversion(with_attn):
+    """``with_attn=True`` mirrors the released f=16/1024 ddconfig's
+    per-block attention at attn_resolutions (here: level 1 of a 16px twin,
+    i.e. resolution 8) — the layout the real checkpoint ships."""
     from dalle_pytorch_tpu.models.pretrained_vae import (VQGanDecoder,
                                                          VQGanEncoder)
 
+    resolution, attn_res = 16, ((8,) if with_attn else ())
+    attn_levels = (1,) if with_attn else ()
     torch.manual_seed(0)
-    t_enc, t_dec = TVQEncoder(), TVQDecoder()
+    t_enc = TVQEncoder(attn_levels=attn_levels)
+    t_dec = TVQDecoder(attn_levels=attn_levels)
     sd = {f"encoder.{k}": v.numpy() for k, v in t_enc.state_dict().items()}
     sd.update({f"decoder.{k}": v.numpy() for k, v in t_dec.state_dict().items()})
     # quantize + 1x1 quant convs
@@ -188,20 +207,24 @@ def test_vqgan_encoder_decoder_conversion():
     sd["post_quant_conv.bias"] = np.zeros(Z, np.float32)
 
     params = convert_vqgan_state_dict(sd, ch=CH, ch_mult=CH_MULT,
-                                      num_res_blocks=NRES)
+                                      num_res_blocks=NRES,
+                                      resolution=resolution,
+                                      attn_resolutions=attn_res)
 
     x = rng.uniform(-1, 1, size=(2, 16, 16, 3)).astype(np.float32)
     with torch.no_grad():
         ref_z = _nhwc(t_enc(_nchw(x)))
     enc = VQGanEncoder(ch=CH, ch_mult=CH_MULT, num_res_blocks=NRES,
-                       z_channels=Z)
+                       z_channels=Z, resolution=resolution,
+                       attn_resolutions=attn_res)
     out_z = np.asarray(enc.apply({"params": params["encoder"]}, jnp.asarray(x)))
     np.testing.assert_allclose(out_z, ref_z, rtol=1e-4, atol=1e-4)
 
     z = rng.uniform(-1, 1, size=(2, 8, 8, Z)).astype(np.float32)
     with torch.no_grad():
         ref_img = _nhwc(t_dec(_nchw(z)))
-    dec = VQGanDecoder(ch=CH, ch_mult=CH_MULT, num_res_blocks=NRES)
+    dec = VQGanDecoder(ch=CH, ch_mult=CH_MULT, num_res_blocks=NRES,
+                       resolution=resolution, attn_resolutions=attn_res)
     out_img = np.asarray(dec.apply({"params": params["decoder"]}, jnp.asarray(z)))
     np.testing.assert_allclose(out_img, ref_img, rtol=1e-4, atol=1e-4)
 
@@ -257,56 +280,37 @@ class OaiDecBlock(tnn.Module):
         return self.id_path(x) + self.res_path(x)
 
 
-def test_openai_encoder_conversion():
-    from dalle_pytorch_tpu.models.pretrained_vae import OpenAIEncoder
-
-    HID, BPG = 32, 1
-    torch.manual_seed(1)
-
+def make_oai_encoder_twin(hid, bpg, vocab):
+    """Torch twin of the DALL-E package Encoder (published naming),
+    parametrized so the full-size test can build it at hid=256/bpg=2/8192."""
     groups = OrderedDict()
-    groups["input"] = OaiConv(3, HID, 7)
-    cin = HID
+    groups["input"] = OaiConv(3, hid, 7)
+    cin = hid
     for g, mult in enumerate((1, 2, 4, 8)):
         grp = OrderedDict()
-        for b in range(BPG):
-            grp[f"block_{b + 1}"] = OaiEncBlock(cin, HID * mult)
-            cin = HID * mult
+        for b in range(bpg):
+            grp[f"block_{b + 1}"] = OaiEncBlock(cin, hid * mult)
+            cin = hid * mult
         if g < 3:
             grp["pool"] = tnn.MaxPool2d(2)
         groups[f"group_{g + 1}"] = tnn.Sequential(grp)
     groups["output"] = tnn.Sequential(OrderedDict([
-        ("relu", tnn.ReLU()), ("conv", OaiConv(cin, 64, 1))]))
-    model = tnn.Sequential(OrderedDict([("blocks", tnn.Sequential(groups))]))
-
-    sd = {k: v.numpy() for k, v in model.state_dict().items()}
-    params = convert_openai_state_dicts(sd, None, hidden=HID,
-                                        blocks_per_group=BPG)
-
-    rng = np.random.default_rng(2)
-    x = rng.uniform(0, 1, size=(1, 16, 16, 3)).astype(np.float32)
-    with torch.no_grad():
-        ref = _nhwc(model(_nchw(x)))
-    enc = OpenAIEncoder(num_tokens=64, hidden=HID, blocks_per_group=BPG)
-    out = np.asarray(enc.apply({"params": params["encoder"]}, jnp.asarray(x)))
-    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+        ("relu", tnn.ReLU()), ("conv", OaiConv(cin, vocab, 1))]))
+    return tnn.Sequential(OrderedDict([("blocks", tnn.Sequential(groups))]))
 
 
-def test_openai_decoder_conversion():
-    from dalle_pytorch_tpu.models.pretrained_vae import OpenAIDecoder
-
-    HID, BPG, VOCAB = 32, 1, 64
-    n_init = HID // 2
-    torch.manual_seed(3)
-
+def make_oai_decoder_twin(hid, bpg, vocab):
+    """Torch twin of the DALL-E package Decoder (published naming)."""
+    n_init = hid // 2
     groups = OrderedDict()
-    groups["input"] = OaiConv(VOCAB, n_init, 1)
+    groups["input"] = OaiConv(vocab, n_init, 1)
     cin = n_init
     ups = []
     for g, mult in enumerate((8, 4, 2, 1)):
         grp = OrderedDict()
-        for b in range(BPG):
-            grp[f"block_{b + 1}"] = OaiDecBlock(cin, HID * mult)
-            cin = HID * mult
+        for b in range(bpg):
+            grp[f"block_{b + 1}"] = OaiDecBlock(cin, hid * mult)
+            cin = hid * mult
         groups[f"group_{g + 1}"] = tnn.Sequential(grp)
         ups.append(g < 3)
     groups["output"] = tnn.Sequential(OrderedDict([
@@ -325,7 +329,35 @@ def test_openai_decoder_conversion():
                     h = F.interpolate(h, scale_factor=2.0, mode="nearest")
             return self.blocks.output(h)
 
-    model = TDec()
+    return TDec()
+
+
+def test_openai_encoder_conversion():
+    from dalle_pytorch_tpu.models.pretrained_vae import OpenAIEncoder
+
+    HID, BPG = 32, 1
+    torch.manual_seed(1)
+    model = make_oai_encoder_twin(HID, BPG, vocab=64)
+
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    params = convert_openai_state_dicts(sd, None, hidden=HID,
+                                        blocks_per_group=BPG)
+
+    rng = np.random.default_rng(2)
+    x = rng.uniform(0, 1, size=(1, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = _nhwc(model(_nchw(x)))
+    enc = OpenAIEncoder(num_tokens=64, hidden=HID, blocks_per_group=BPG)
+    out = np.asarray(enc.apply({"params": params["encoder"]}, jnp.asarray(x)))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_openai_decoder_conversion():
+    from dalle_pytorch_tpu.models.pretrained_vae import OpenAIDecoder
+
+    HID, BPG, VOCAB = 32, 1, 64
+    torch.manual_seed(3)
+    model = make_oai_decoder_twin(HID, BPG, VOCAB)
     sd = {k: v.numpy() for k, v in model.state_dict().items()}
     params = convert_openai_state_dicts(sd, sd, hidden=HID,
                                         blocks_per_group=BPG)
@@ -373,11 +405,13 @@ class TClipBlock(tnn.Module):
         return x + self.mlp.c_proj(h)
 
 
-def test_clip_vit_conversion():
-    from dalle_pytorch_tpu.models.clip_vit import CLIPViT, CLIPViTConfig
-
-    W, HEADS, LAYERS, PATCH, IMG, VOCAB, CTX, EMB = 32, 4, 2, 8, 16, 50, 8, 16
-    torch.manual_seed(5)
+def make_clip_twin(W, HEADS, LAYERS, PATCH, IMG, VOCAB, CTX, EMB,
+                   TEXT_W=None, TEXT_HEADS=None):
+    """Torch twin of the released clip package's ViT model (its state_dict
+    naming), parametrized so the full-size test can build ViT-B/32 (where
+    the text tower is narrower: width 512 / 8 heads vs vision 768 / 12)."""
+    TEXT_W = W if TEXT_W is None else TEXT_W
+    TEXT_HEADS = HEADS if TEXT_HEADS is None else TEXT_HEADS
 
     class TClip(tnn.Module):
         def __init__(self):
@@ -396,14 +430,16 @@ def test_clip_vit_conversion():
             v.ln_post = tnn.LayerNorm(W)
             v.proj = tnn.Parameter(torch.randn(W, EMB) * 0.1)
             self.visual = v
-            self.token_embedding = tnn.Embedding(VOCAB, W)
-            self.positional_embedding = tnn.Parameter(torch.randn(CTX, W) * 0.1)
+            self.token_embedding = tnn.Embedding(VOCAB, TEXT_W)
+            self.positional_embedding = tnn.Parameter(
+                torch.randn(CTX, TEXT_W) * 0.1)
             t = _Holder()
             t.resblocks = tnn.ModuleList(
-                [TClipBlock(W, HEADS, True) for _ in range(LAYERS)])
+                [TClipBlock(TEXT_W, TEXT_HEADS, True) for _ in range(LAYERS)])
             self.transformer = t
-            self.ln_final = tnn.LayerNorm(W)
-            self.text_projection = tnn.Parameter(torch.randn(W, EMB) * 0.1)
+            self.ln_final = tnn.LayerNorm(TEXT_W)
+            self.text_projection = tnn.Parameter(
+                torch.randn(TEXT_W, EMB) * 0.1)
             self.logit_scale = tnn.Parameter(torch.tensor(2.0))
 
         def encode_image(self, x):
@@ -424,7 +460,15 @@ def test_clip_vit_conversion():
             eot = text.argmax(dim=-1)
             return h[torch.arange(h.shape[0]), eot] @ self.text_projection
 
-    model = TClip()
+    return TClip()
+
+
+def test_clip_vit_conversion():
+    from dalle_pytorch_tpu.models.clip_vit import CLIPViT, CLIPViTConfig
+
+    W, HEADS, LAYERS, PATCH, IMG, VOCAB, CTX, EMB = 32, 4, 2, 8, 16, 50, 8, 16
+    torch.manual_seed(5)
+    model = make_clip_twin(W, HEADS, LAYERS, PATCH, IMG, VOCAB, CTX, EMB)
     sd = {k: v.numpy() for k, v in model.state_dict().items()}
     params = convert_clip_state_dict(sd, vision_layers=LAYERS,
                                      text_layers=LAYERS)

@@ -1,0 +1,249 @@
+"""FULL-SIZE converter validation (VERDICT round-1 item 6).
+
+The small-twin tests prove the tensor transforms; these prove the *name
+maps at the published sizes*: torch twins are built at the exact released
+geometries — taming VQGAN f=16/1024 (`vqgan_imagenet_f16_1024` ddconfig:
+ch 128, ch_mult (1,1,2,2,4), 2 res blocks, z 256, attn_resolutions [16]),
+the OpenAI dVAE (n_hid 256, 2 blocks/group, vocab 8192), and CLIP ViT-B/32
+(768/12x12/patch 32/embed 512/vocab 49408/ctx 77) — their full state dicts
+run through tools/convert_weights.py with every key access *tracked*, and
+the test fails if any published weight key goes unconsumed (the
+"single renamed key only surfaces at deployment" failure mode).  Forwards
+through the full-size flax graphs are compared numerically to the torch
+twins, and the wrapper classes are driven end-to-end at 256px.
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from unittest import mock
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import test_weight_conversion as twc  # noqa: E402  (shared torch twins)
+from tools.convert_weights import (convert_clip_state_dict,  # noqa: E402
+                                   convert_openai_state_dicts,
+                                   convert_vqgan_state_dict,
+                                   infer_clip_config)
+
+
+class TrackedSD(dict):
+    """State dict recording which keys the converter consumed."""
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.used = set()
+
+    def __getitem__(self, key):
+        self.used.add(key)
+        return super().__getitem__(key)
+
+
+def _scaled(sd):
+    """Sane random weights for full-size graphs: norm scales ~1, biases
+    small, matmul/conv kernels fan-in scaled — keeps 20+-layer forward
+    activations O(1) so the torch/flax comparison isn't drowned in the
+    float noise of exploding magnitudes."""
+    rng = np.random.default_rng(0)
+    out = {}
+    for k, v in sd.items():
+        if v.ndim <= 1 and k.endswith(".weight"):  # norm scale vectors
+            out[k] = (1.0 + 0.01 * rng.normal(size=v.shape)).astype(np.float32)
+        elif v.ndim <= 1:  # biases, class/logit scalars
+            out[k] = (0.01 * rng.normal(size=v.shape)).astype(np.float32)
+        else:
+            fan_in = int(np.prod(v.shape) // v.shape[0])
+            out[k] = (rng.normal(size=v.shape) /
+                      np.sqrt(fan_in)).astype(np.float32)
+    return out
+
+
+_nchw, _nhwc = twc._nchw, twc._nhwc  # shared layout helpers
+
+
+def _load_torch(model, sd):
+    model.load_state_dict({k: torch.as_tensor(np.asarray(v))
+                           for k, v in sd.items()})
+    return model.eval()
+
+
+@mock.patch.multiple(twc, CH=128, CH_MULT=(1, 1, 2, 2, 4), NRES=2, Z=256)
+def test_vqgan_f16_1024_fullsize():
+    # the patch stays active for the twins' forward passes too — they read
+    # the module constants at call time
+    from dalle_pytorch_tpu.models.pretrained_vae import (VQGanDecoder,
+                                                         VQGanEncoder,
+                                                         VQGanVAE1024)
+
+    t_enc = twc.TVQEncoder(attn_levels=(4,))   # attn at resolution 16
+    t_dec = twc.TVQDecoder(attn_levels=(4,))
+    sd = {f"encoder.{k}": v.numpy() for k, v in t_enc.state_dict().items()}
+    sd.update({f"decoder.{k}": v.numpy()
+               for k, v in t_dec.state_dict().items()})
+    sd["quantize.embedding.weight"] = np.zeros((1024, 256), np.float32)
+    sd["quant_conv.weight"] = np.zeros((256, 256, 1, 1), np.float32)
+    sd["quant_conv.bias"] = np.zeros(256, np.float32)
+    sd["post_quant_conv.weight"] = np.zeros((256, 256, 1, 1), np.float32)
+    sd["post_quant_conv.bias"] = np.zeros(256, np.float32)
+    sd = TrackedSD(_scaled(sd))
+    # the released ckpt also carries training-only heads the converter must
+    # ignore (and nothing else may be ignored)
+    loss_keys = {"loss.perceptual_loss.net.slice1.0.weight",
+                 "loss.discriminator.main.0.weight",
+                 "loss.logvar"}
+    for k in loss_keys:
+        dict.__setitem__(sd, k, np.zeros(1, np.float32))
+
+    params = convert_vqgan_state_dict(sd)  # defaults == published config
+
+    unconsumed = set(sd) - sd.used
+    assert unconsumed == loss_keys, (
+        f"published weight keys the converter never read: "
+        f"{sorted(unconsumed - loss_keys)[:10]}")
+
+    # numerical fidelity of the full-size weights (64px input keeps the CPU
+    # cost down; the graphs' attn placement follows the 256px config either
+    # way, and all 67M converted weights participate)
+    _load_torch(t_enc, {k[len("encoder."):]: v for k, v in sd.items()
+                        if k.startswith("encoder.")})
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-1, 1, size=(1, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref_z = _nhwc(t_enc(_nchw(x)))
+    out_z = np.asarray(VQGanEncoder().apply(
+        {"params": params["encoder"]}, jnp.asarray(x)))
+    np.testing.assert_allclose(out_z, ref_z, rtol=1e-3, atol=1e-4)
+
+    _load_torch(t_dec, {k[len("decoder."):]: v for k, v in sd.items()
+                        if k.startswith("decoder.")})
+    z = rng.uniform(-1, 1, size=(1, 4, 4, 256)).astype(np.float32)
+    with torch.no_grad():
+        ref_img = _nhwc(t_dec(_nchw(z)))
+    out_img = np.asarray(VQGanDecoder().apply(
+        {"params": params["decoder"]}, jnp.asarray(z)))
+    np.testing.assert_allclose(out_img, ref_img, rtol=1e-3, atol=1e-4)
+
+    # wrapper end-to-end at the real 256px geometry (ref vae.py:132-170),
+    # VALUE-checked against a torch reference of the taming quantize
+    # pipeline (encoder -> quant_conv incl. bias -> nearest codebook;
+    # codebook lookup -> post_quant_conv incl. bias -> decoder)
+    vae = VQGanVAE1024()
+    vae.params = params
+    img = rng.uniform(0, 1, size=(1, 256, 256, 3)).astype(np.float32)
+    codes = np.asarray(vae.get_codebook_indices(jnp.asarray(img)))
+    assert codes.shape == (1, 256) and codes.max() < 1024  # 16x16, f=16
+
+    with torch.no_grad():
+        tz = t_enc(_nchw(2.0 * img - 1.0))
+        tz = torch.nn.functional.conv2d(
+            tz, torch.as_tensor(sd["quant_conv.weight"]),
+            torch.as_tensor(sd["quant_conv.bias"]))
+        flat = tz.flatten(2).permute(0, 2, 1).reshape(-1, 256)
+        cb = torch.as_tensor(sd["quantize.embedding.weight"])
+        ref_codes = torch.cdist(flat, cb).argmin(-1).reshape(1, -1).numpy()
+    assert (codes == ref_codes).mean() > 0.99  # ties aside, identical
+
+    recon = np.asarray(vae.decode(jnp.asarray(ref_codes)))
+    with torch.no_grad():
+        zq = cb[torch.as_tensor(ref_codes)].reshape(1, 16, 16, 256)
+        zq = torch.nn.functional.conv2d(
+            zq.permute(0, 3, 1, 2),
+            torch.as_tensor(sd["post_quant_conv.weight"]),
+            torch.as_tensor(sd["post_quant_conv.bias"]))
+        ref_recon = (np.clip(_nhwc(t_dec(zq)), -1, 1) + 1) * 0.5
+    np.testing.assert_allclose(recon, ref_recon, rtol=1e-3, atol=1e-3)
+
+
+def test_openai_dvae_fullsize():
+    from dalle_pytorch_tpu.models.pretrained_vae import (OpenAIDecoder,
+                                                         OpenAIDiscreteVAE,
+                                                         OpenAIEncoder)
+
+    t_enc = twc.make_oai_encoder_twin(hid=256, bpg=2, vocab=8192)
+    t_dec = twc.make_oai_decoder_twin(hid=256, bpg=2, vocab=8192)
+    enc_sd = TrackedSD(_scaled(
+        {k: v.numpy() for k, v in t_enc.state_dict().items()}))
+    dec_sd = TrackedSD(_scaled(
+        {k: v.numpy() for k, v in t_dec.state_dict().items()}))
+
+    params = convert_openai_state_dicts(enc_sd, dec_sd)  # published defaults
+
+    assert set(enc_sd) == enc_sd.used, (
+        f"unread encoder keys: {sorted(set(enc_sd) - enc_sd.used)[:10]}")
+    assert set(dec_sd) == dec_sd.used, (
+        f"unread decoder keys: {sorted(set(dec_sd) - dec_sd.used)[:10]}")
+
+    _load_torch(t_enc, dict(enc_sd))
+    rng = np.random.default_rng(2)
+    x = rng.uniform(0, 1, size=(1, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = _nhwc(t_enc(_nchw(x)))
+    out = np.asarray(OpenAIEncoder().apply(
+        {"params": params["encoder"]}, jnp.asarray(x)))
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    _load_torch(t_dec, dict(dec_sd))
+    onehot = np.zeros((1, 4, 4, 8192), np.float32)
+    onehot.reshape(16, 8192)[np.arange(16),
+                             rng.integers(0, 8192, 16)] = 1.0
+    with torch.no_grad():
+        ref = _nhwc(t_dec(_nchw(onehot)))
+    out = np.asarray(OpenAIDecoder().apply(
+        {"params": params["decoder"]}, jnp.asarray(onehot)))
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    # wrapper end-to-end at 256px (ref vae.py:98-127: f=8 -> 32x32 codes)
+    vae = OpenAIDiscreteVAE()
+    vae.params = params
+    img = rng.uniform(0, 1, size=(1, 256, 256, 3)).astype(np.float32)
+    codes = np.asarray(vae.get_codebook_indices(jnp.asarray(img)))
+    assert codes.shape == (1, 1024) and codes.max() < 8192
+    recon = np.asarray(vae.decode(jnp.asarray(codes)))
+    assert recon.shape == (1, 256, 256, 3) and np.isfinite(recon).all()
+
+
+def test_clip_vit_b32_fullsize():
+    from dalle_pytorch_tpu.models.clip_vit import CLIPViT, CLIPViTConfig
+
+    model = twc.make_clip_twin(W=768, HEADS=12, LAYERS=12, PATCH=32,
+                               IMG=224, VOCAB=49408, CTX=77, EMB=512,
+                               TEXT_W=512, TEXT_HEADS=8)
+    sd = TrackedSD(_scaled(
+        {k: v.numpy() for k, v in model.state_dict().items()}))
+
+    # geometry inference must reproduce the published ViT-B/32 numbers
+    cfg_d = infer_clip_config(sd)
+    assert cfg_d == dict(image_size=224, patch_size=32, vision_width=768,
+                         vision_layers=12, vision_heads=12, embed_dim=512,
+                         text_width=512, text_layers=12, text_heads=8,
+                         context_length=77, vocab_size=49408)
+
+    params = convert_clip_state_dict(sd, vision_layers=12, text_layers=12)
+    assert set(sd) == sd.used, (
+        f"unread CLIP keys: {sorted(set(sd) - sd.used)[:10]}")
+
+    _load_torch(model, dict(sd))
+    cfg = CLIPViTConfig(**cfg_d)
+    clip = CLIPViT(cfg)
+    rng = np.random.default_rng(3)
+    img = rng.normal(size=(1, 224, 224, 3)).astype(np.float32)
+    text = np.zeros((1, 77), np.int64)
+    text[0, :5] = [100, 200, 300, 5, 49407]  # 49407 = EOT (max id)
+    with torch.no_grad():
+        ref_i = model.encode_image(_nchw(img)).numpy()
+        ref_t = model.encode_text(torch.from_numpy(text)).numpy()
+    out_i = np.asarray(clip.apply({"params": params}, jnp.asarray(img),
+                                  method=CLIPViT.encode_image))
+    out_t = np.asarray(clip.apply({"params": params},
+                                  jnp.asarray(text, jnp.int32),
+                                  method=CLIPViT.encode_text))
+    # f32 accumulation-order noise through 12 layers x width 768 reaches
+    # ~5e-3 absolute on O(1) outputs; a wrong key map yields garbage, so
+    # this tolerance still catches every mapping/transpose error
+    np.testing.assert_allclose(out_i, ref_i, rtol=5e-3, atol=8e-3)
+    np.testing.assert_allclose(out_t, ref_t, rtol=5e-3, atol=8e-3)

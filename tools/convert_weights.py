@@ -81,9 +81,20 @@ def _vq_attnblock(params, sd, flax_prefix, torch_prefix):
 
 def convert_vqgan_state_dict(sd: dict, ch: int = 128,
                              ch_mult=(1, 1, 2, 2, 4),
-                             num_res_blocks: int = 2) -> dict:
+                             num_res_blocks: int = 2,
+                             resolution: int = 256,
+                             attn_resolutions=(16,)) -> dict:
     """taming VQModel state_dict -> VQGanVAE1024 params dict
-    ({encoder, decoder, codebook, quant_proj, post_quant_proj})."""
+    ({encoder, decoder, codebook, quant_proj, post_quant_proj}).
+
+    ``attn_resolutions`` follows the released `vqgan_imagenet_f16_1024`
+    ddconfig: levels running at those resolutions interleave AttnBlocks
+    after every res block (`encoder.down.4.attn.{0,1}`,
+    `decoder.up.4.attn.{0,1,2}` in the published checkpoint)."""
+    from dalle_pytorch_tpu.models.pretrained_vae import vqgan_attn_levels
+
+    attn_levels = vqgan_attn_levels(resolution, tuple(ch_mult),
+                                    tuple(attn_resolutions))
     enc: dict = {}
     _set(enc, "conv_in/kernel", _conv(sd, "encoder.conv_in.weight"))
     _set(enc, "conv_in/bias", _vec(sd, "encoder.conv_in.bias"))
@@ -95,6 +106,9 @@ def convert_vqgan_state_dict(sd: dict, ch: int = 128,
                          f"encoder.down.{i}.block.{b}",
                          has_shortcut=(c_in != c_out))
             c_in = c_out
+            if i in attn_levels:
+                _vq_attnblock(enc, sd, f"down_{i}_attn_{b}",
+                              f"encoder.down.{i}.attn.{b}")
         if i < len(ch_mult) - 1:
             _set(enc, f"down_{i}_downsample/kernel",
                  _conv(sd, f"encoder.down.{i}.downsample.conv.weight"))
@@ -126,6 +140,9 @@ def convert_vqgan_state_dict(sd: dict, ch: int = 128,
                          f"decoder.up.{lvl}.block.{b}",
                          has_shortcut=(c_in != c_out))
             c_in = c_out
+            if lvl in attn_levels:
+                _vq_attnblock(dec, sd, f"up_{i}_attn_{b}",
+                              f"decoder.up.{lvl}.attn.{b}")
         if i < n - 1:
             _set(dec, f"up_{i}_upsample/kernel",
                  _conv(sd, f"decoder.up.{lvl}.upsample.conv.weight"))
