@@ -1,10 +1,16 @@
-"""Test configuration: force an 8-device virtual CPU mesh.
+"""Test configuration: force an 8-device virtual CPU mesh + fast profile.
 
 The TPU-native analog of "multi-node testing without a cluster" (SURVEY.md
 §4): all distributed/sharding tests run on 8 virtual CPU devices via
 ``--xla_force_host_platform_device_count`` — the real TPU is only used by
 bench.py.  Must run before any backend is initialized; the axon TPU plugin
 registered in sitecustomize is overridden via jax.config.
+
+Fast profile: long-running tests (end-to-end training, multiprocess
+integration, full-size weight conversion, ...) carry ``@pytest.mark.slow``
+and are skipped unless ``--runslow`` is passed — so the default
+``python -m pytest tests/ -x -q`` is the always-green quick contract and
+``--runslow`` is the full nightly sweep (see .github/workflows/tests.yml).
 """
 import os
 
@@ -15,3 +21,24 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption("--runslow", action="store_true", default=False,
+                     help="also run tests marked slow (the full sweep)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded unless --runslow")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow: pass --runslow to include")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -257,6 +257,7 @@ def test_main_emits_json_before_stages(monkeypatch, capsys):
     assert parsed["meta"]["use_pallas"] is False
 
 
+@pytest.mark.slow
 def test_perf_ab_tool(monkeypatch, capsys):
     """tools/perf_ab.py runs interleaved variants end-to-end (tiny config)."""
     from pathlib import Path

@@ -188,6 +188,7 @@ def _first_loss(workdir):
     return float(logs[-1].read_text().strip().split("\n")[0].split(" ")[2])
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("sp_impl,sp", [("ring", 4), ("ulysses", 2)])
 def test_train_dalle_sequence_parallel_cli(trained_vae, tiny_dataset,
                                            tiny_tokenizer_json,
@@ -299,6 +300,7 @@ def test_generate_cli_pickle_eval_mode(trained_dalle, tiny_tokenizer_json,
     assert len(jpgs) == 3  # one image per caption
 
 
+@pytest.mark.slow
 def test_genrank_cli_with_clip_vit(trained_dalle, tiny_tokenizer_json,
                                    workdir):
     """Ranking through a converted-official-CLIP-style (CLIPViT) ranker."""
@@ -484,6 +486,7 @@ def test_analyze_logs_cli(tmp_path, capsys):
     assert lines[0].split(",")[:2] == ["run", "epoch"]
 
 
+@pytest.mark.slow
 def test_train_dalle_sharded_checkpoints(trained_vae, tiny_dataset,
                                          tiny_tokenizer_json, tmp_path):
     """--sharded_checkpoints writes Orbax dirs ({name}.orbax, per-host
@@ -590,6 +593,7 @@ def test_train_vae_resume(trained_vae, tiny_dataset, workdir, monkeypatch):
     assert float(after["lr"]) <= float(before["lr"])
 
 
+@pytest.mark.slow
 def test_sharded_checkpoint_cross_mesh_resume(trained_vae, tiny_dataset,
                                               tiny_tokenizer_json, tmp_path,
                                               monkeypatch):

@@ -91,6 +91,7 @@ def test_top_k_filter_semantics():
     assert set(kept) == set(np.argsort(row)[-k:])
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("attn_types,reversible", [
     (("full",), False),
     (("full", "axial_row", "axial_col", "conv_like"), False),
@@ -133,6 +134,7 @@ def test_priming(small):
     np.testing.assert_array_equal(out[:, :n_prime], np.asarray(prime))
 
 
+@pytest.mark.slow
 def test_grads_flow(small):
     cfg, dalle, params, text, codes = small
 

@@ -21,6 +21,8 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # full tier only (--runslow)
+
 REPO = Path(__file__).resolve().parent.parent
 
 DALLE_HPARAMS = dict(BATCH_SIZE=2, MODEL_DIM=32, TEXT_SEQ_LEN=8, DEPTH=2,

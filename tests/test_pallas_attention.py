@@ -111,6 +111,7 @@ def test_bf16_forward_close():
                                rtol=5e-2, atol=5e-2)
 
 
+@pytest.mark.slow
 def test_dalle_use_pallas_matches_dense():
     """Full DALLE forward loss with the Pallas kernels == dense path."""
     from dalle_pytorch_tpu import DALLE, DALLEConfig

@@ -83,6 +83,7 @@ def test_sp_config_init_matches_dense():
     assert _max_delta(params, sp_params) == 0.0
 
 
+@pytest.mark.slow
 def test_pp_train_step_matches_dense():
     """GPipe is an exact schedule: one pp step == one dense step, and the
     dense<->staged param conversion round-trips losslessly."""
@@ -107,6 +108,7 @@ def test_pp_train_step_matches_dense():
                                              mesh)) < 1e-5
 
 
+@pytest.mark.slow
 def test_moe_train_step_learns_and_counts_aux():
     """The MoE step carries the sown load-balance aux in its loss (a plain
     apply would silently drop it) and the loss decreases over steps."""

@@ -98,6 +98,7 @@ def test_pipeline_dp_times_pp(mesh_dp2pp2):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_gradients(mesh_pp2):
     tf, params, x = setup(jax.random.PRNGKey(4))
     _, stacked, apply_fn = pipeline_transformer(

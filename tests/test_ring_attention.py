@@ -68,6 +68,7 @@ def test_ring_dp_times_sp(mesh2x4):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_gradients(mesh8):
     q, k, v = rand_qkv(jax.random.PRNGKey(3))
     tangent = jax.random.normal(jax.random.PRNGKey(4), q.shape)

@@ -65,6 +65,7 @@ def test_ulysses_dp_times_sp(mesh2x4):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_gradients(mesh8):
     q, k, v = rand_qkv(jax.random.PRNGKey(3))
     tangent = jax.random.normal(jax.random.PRNGKey(4), q.shape)
@@ -82,6 +83,7 @@ def test_ulysses_gradients(mesh8):
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_transformer_ulysses_matches_local(mesh2x4):
     """A Transformer stack under shard_map with sp_impl='ulysses' matches
     the same stack run unsharded."""

@@ -31,6 +31,8 @@ from tools.convert_weights import (convert_clip_state_dict,  # noqa: E402
                                    convert_vqgan_state_dict,
                                    infer_clip_config)
 
+pytestmark = pytest.mark.slow  # full tier only (--runslow)
+
 
 class TrackedSD(dict):
     """State dict recording which keys the converter consumed."""

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Produce a CUB-shaped loss trajectory in the reference's log format.
+
+The reference's committed training evidence is `all-logs/cool-frog-21.txt`
+(one `epoch iter loss lr` line per step, written at ref train_dalle.py:378;
+654 iters/epoch = ~10.5k caption pairs at batch 16): first loss ~7.36,
+epoch-99 mean ~4.28.  CUB images cannot ship in this environment, so this
+harness trains the same model geometry (cool-frog-21's: dim 256 / depth 8 /
+heads 8 / text 80 / VQGAN-1024 codes -> 256 image tokens / batch 16 /
+lr from flag) on a SYNTHETIC caption->codes dataset with learnable
+conditional structure: each of `--num_pairs` captions deterministically
+selects a code template, observed under token noise — so the loss must fall
+from the ~7.4 init toward the template entropy, exercising the identical
+train step the real run uses (training.make_dalle_train_step, codes path).
+
+Usage:
+    python tools/loss_curve.py --steps 400 --out all-logs-tpu/synthetic-cub.txt
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def make_synthetic_pairs(rng, num_pairs, text_len, vocab, image_seq,
+                         image_vocab, templates=512, noise=0.15):
+    """Caption tokens -> noisy code template: conditional structure a
+    transformer can actually learn (pure noise would plateau at ln V)."""
+    caps = rng.integers(1, vocab, size=(num_pairs, text_len))
+    tmpl_of_cap = rng.integers(0, templates, size=num_pairs)
+    templates_codes = rng.integers(0, image_vocab,
+                                   size=(templates, image_seq))
+    codes = templates_codes[tmpl_of_cap]
+    flip = rng.random(codes.shape) < noise
+    codes = np.where(flip, rng.integers(0, image_vocab, codes.shape), codes)
+    return caps.astype(np.int32), codes.astype(np.int32)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--steps", type=int, default=400)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--learning_rate", type=float, default=3e-4)
+    parser.add_argument("--num_pairs", type=int, default=10464,
+                        help="654 iters/epoch x batch 16, as cool-frog-21")
+    parser.add_argument("--out", type=str,
+                        default="all-logs-tpu/synthetic-cub.txt")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import DALLE, DALLEConfig
+    from dalle_pytorch_tpu.training import (make_dalle_train_step,
+                                            make_optimizer)
+
+    cfg = DALLEConfig(
+        dim=256, num_text_tokens=7800, text_seq_len=80, depth=8, heads=8,
+        dim_head=64, attn_types=("full", "axial_row", "axial_col",
+                                 "conv_like"),
+        num_image_tokens=1024, image_size=256, image_fmap_size=16,
+        dtype=jnp.float32)
+    model = DALLE(cfg)
+
+    host = np.random.default_rng(args.seed)
+    caps, codes = make_synthetic_pairs(
+        host, args.num_pairs, cfg.text_seq_len, cfg.num_text_tokens,
+        cfg.image_seq_len, cfg.num_image_tokens)
+
+    rng = jax.random.PRNGKey(args.seed)
+    params = jax.jit(lambda r: model.init(
+        r, jnp.asarray(caps[:1]), jnp.asarray(codes[:1]))["params"])(rng)
+    tx = make_optimizer(args.learning_rate)
+    opt_state = jax.jit(tx.init)(params)
+    step_fn = make_dalle_train_step(model, tx)
+
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    iters_per_epoch = args.num_pairs // args.batch_size
+    order = host.permutation(args.num_pairs)
+    t0 = time.time()
+    with out.open("w") as f:
+        for step in range(args.steps):
+            epoch, it = divmod(step, iters_per_epoch)
+            if it == 0:
+                order = np.random.default_rng(
+                    args.seed + epoch).permutation(args.num_pairs)
+            sel = order[it * args.batch_size:(it + 1) * args.batch_size]
+            rng, k = jax.random.split(rng)
+            params, opt_state, loss = step_fn(
+                params, opt_state, None, jnp.asarray(caps[sel]),
+                jnp.asarray(codes[sel]), k)
+            loss_v = float(loss)
+            # the reference's exact line format (ref train_dalle.py:378)
+            f.write(f"{epoch} {it} {loss_v} {args.learning_rate}\n")
+            f.flush()
+            if step % 10 == 0:
+                rate = (step + 1) / (time.time() - t0)
+                print(f"step {step}: loss {loss_v:.4f} "
+                      f"({rate:.2f} steps/s)", flush=True)
+    print(f"wrote {args.steps} lines to {out}")
+
+
+if __name__ == "__main__":
+    main()
