@@ -333,11 +333,17 @@ def _flash_attention(pattern: AttnPattern, block_q: int, block_k: int,
     return out
 
 
+def _padded_len(n: int, block_q: int, block_k: int) -> int:
+    """The kernel's actual padded sequence length — shared with the VMEM
+    guard so its estimate can never diverge from what _prepare allocates."""
+    n_pad = _round_up(n, max(block_q, block_k))
+    n_pad = _round_up(n_pad, block_q)
+    return _round_up(n_pad, block_k)
+
+
 def _prepare(pattern, block_q, block_k, q, bias):
     b, h, n, dh = q.shape
-    n_pad = _round_up(n, max(block_q, block_k) * 1)
-    n_pad = _round_up(n_pad, block_q)
-    n_pad = _round_up(n_pad, block_k)
+    n_pad = _padded_len(n, block_q, block_k)
     mask_np, bsum_np = _pattern_blocks(pattern, n, n_pad, block_q, block_k)
     mask = jnp.asarray(mask_np)
     bsum = jnp.asarray(bsum_np)
@@ -393,6 +399,20 @@ def _flash_bwd(pattern, block_q, block_k, interpret, residuals, g):
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+# Per-core VMEM is ~16 MB on current TPUs; the kernel keeps each program's
+# full-sequence K/V (plus the padded [n_pad, n_pad] bool mask tile rows)
+# VMEM-resident, which is the right call at the CUB geometry (n=1104:
+# ~0.6 MB K/V) but stops scaling with n.  Budget conservatively at half of
+# VMEM so q/o/acc tiles, the mask and double-buffering still fit.
+VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def _vmem_resident_bytes(n_pad: int, dh: int, itemsize: int,
+                         block_q: int) -> int:
+    # K + V [n_pad, dh] + mask rows [block_q, n_pad] (bool) per program
+    return 2 * n_pad * dh * itemsize + block_q * n_pad
+
+
 def flash_pattern_attention(q, k, v, pattern: AttnPattern,
                             key_pad_bias: Optional[jax.Array] = None, *,
                             block_q: int = 128, block_k: int = 128,
@@ -402,9 +422,25 @@ def flash_pattern_attention(q, k, v, pattern: AttnPattern,
     q/k/v: [b, heads, n, dim_head]; `key_pad_bias` is an optional additive
     f32 [b, n] key bias (0 keep / -1e30 drop) carrying the per-sample key
     padding mask.  Returns [b, heads, n, dim_head] in q's dtype.
+
+    Raises ValueError when the sequence is long enough that the
+    VMEM-resident K/V design would overflow the per-core budget — callers
+    should fall back to the dense-masked XLA path (or sequence parallelism,
+    parallel/ring.py) instead of letting Mosaic fail opaquely mid-compile.
+    The guard only applies to real TPU compilation; the interpreter
+    (CPU/GPU correctness runs) has no VMEM limit.
     """
+    b, _, n, dh = q.shape
+    n_pad = _padded_len(n, block_q, block_k)
+    resident = _vmem_resident_bytes(n_pad, dh, q.dtype.itemsize, block_q)
+    if resident > VMEM_BUDGET_BYTES and not interpret:
+        raise ValueError(
+            f"flash_pattern_attention keeps full-sequence K/V VMEM-resident: "
+            f"n={n} (padded {n_pad}), dh={dh} needs ~{resident / 1e6:.1f} MB "
+            f"of the ~{VMEM_BUDGET_BYTES / 1e6:.0f} MB budget. Use the dense "
+            "path (use_pallas=False) or sequence parallelism (ring_axis) "
+            "for sequences this long.")
     if key_pad_bias is None:
-        b, _, n, _ = q.shape
         key_pad_bias = jnp.zeros((b, n), jnp.float32)
     return _flash_attention(pattern, block_q, block_k, interpret,
                             q, k, v, key_pad_bias)
