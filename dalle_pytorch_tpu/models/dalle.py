@@ -307,12 +307,26 @@ class DALLE(nn.Module):
             tokens = tokens[:, : cfg.seq_len]
         return tokens
 
+    def _head(self, out, image_only: bool = False):
+        """final-norm (f32) + logits head — shared by the dense loss, the
+        sp loss, the inference forward and the prefill/decode paths."""
+        return self.to_logits_dense(self.final_norm(out.astype(jnp.float32)),
+                                    image_only=image_only)
+
+    @staticmethod
+    def _phase_nll(phase_logits, labels):
+        """Per-position negative log-likelihood within one vocab phase."""
+        lse = jax.nn.logsumexp(phase_logits, axis=-1)
+        ll = jnp.take_along_axis(
+            phase_logits, labels[:, :, None], axis=-1)[..., 0]
+        return lse - ll
+
     def loss_from_hidden(self, out, text, image_codes):
         """final-norm + logits head + phase-sliced CE over full-sequence
         transformer output ``out`` [b, n, d] (the second half of the dense
         training forward; also the pipeline trainer's exit path)."""
         cfg = self.cfg
-        logits = self.to_logits_dense(self.final_norm(out.astype(jnp.float32)))
+        logits = self._head(out)
         # Phase-sliced cross-entropy: text positions normalize over the text
         # vocab, image positions over the image vocab.  Identical to the
         # reference's masked-logits softmax (ref :482-499 — masked entries
@@ -320,17 +334,10 @@ class DALLE(nn.Module):
         # [b, n, total_tokens] logprobs/mask tensors: at the CUB geometry
         # that skips ~2 x 1.1 GB of HBM traffic per step.
         T, V_text = cfg.text_seq_len, cfg.total_text_tokens
-
-        def phase_ce(phase_logits, labels):
-            lse = jax.nn.logsumexp(phase_logits, axis=-1)
-            ll = jnp.take_along_axis(
-                phase_logits, labels[:, :, None], axis=-1)[..., 0]
-            return (lse - ll).mean()
-
         # labels: next-token over [text[1:], image codes] (ref :489-499)
-        loss_text = phase_ce(logits[:, :T, :V_text],
-                             self._remap_pad_tokens(text))
-        loss_img = phase_ce(logits[:, T:, V_text:], image_codes)
+        loss_text = self._phase_nll(logits[:, :T, :V_text],
+                                    self._remap_pad_tokens(text)).mean()
+        loss_img = self._phase_nll(logits[:, T:, V_text:], image_codes).mean()
         return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)
 
     def _sp_loss(self, text, image_codes, onehot: bool, deterministic: bool):
@@ -354,8 +361,7 @@ class DALLE(nn.Module):
         x = jax.lax.dynamic_slice_in_dim(tokens, idx * L, L, axis=1)
 
         out = self.transformer(x, deterministic=deterministic)
-        logits = self.to_logits_dense(
-            self.final_norm(out.astype(jnp.float32)))  # [b, L, total_tokens]
+        logits = self._head(out)               # [b, L, total_tokens]
 
         T, V_text = cfg.text_seq_len, cfg.total_text_tokens
         pos = idx * L + jnp.arange(L)          # global positions of my shard
@@ -366,10 +372,8 @@ class DALLE(nn.Module):
                          jnp.clip(pos - T, 0, image_codes.shape[1] - 1), axis=1)
 
         def phase_ce_sum(phase_logits, labels, sel):
-            lse = jax.nn.logsumexp(phase_logits, axis=-1)
-            ll = jnp.take_along_axis(
-                phase_logits, labels[:, :, None], axis=-1)[..., 0]
-            return jnp.where(sel[None, :], lse - ll, 0.0).sum()
+            return jnp.where(sel[None, :],
+                             self._phase_nll(phase_logits, labels), 0.0).sum()
 
         b = text.shape[0]
         sum_t = jax.lax.psum(
@@ -402,8 +406,7 @@ class DALLE(nn.Module):
                                deterministic=deterministic)
 
         if not return_loss:
-            logits = self.to_logits_dense(
-                self.final_norm(out.astype(jnp.float32)))
+            logits = self._head(out)
             return jnp.where(self._logits_mask(n)[None],
                              max_neg_value(logits.dtype), logits)
 
@@ -430,8 +433,7 @@ class DALLE(nn.Module):
         out, kvs = self.transformer(tokens, mask=self._pad_mask_for_bos(mask),
                                     return_kv=True)
         last = out[:, n_pre - 1 : n_pre]
-        logits = self.to_logits_dense(self.final_norm(last.astype(jnp.float32)),
-                                      image_only=True)
+        logits = self._head(last, image_only=True)
         return logits[:, 0], kvs
 
     def decode_step(self, code, caches, index, mask=None):
@@ -449,8 +451,7 @@ class DALLE(nn.Module):
         x = emb.astype(cfg.dtype)
         out, caches = self.transformer.decode_step(
             x, caches, index, mask=self._pad_mask_for_bos(mask))
-        logits = self.to_logits_dense(self.final_norm(out.astype(jnp.float32)),
-                                      image_only=True)
+        logits = self._head(out, image_only=True)
         return logits[:, 0], caches
 
 

@@ -65,7 +65,7 @@ def _dalle_loss(dalle, params, text, codes, rng):
     """Training loss incl. the MoE load-balance aux when the model routes
     its FFs through experts (the sown 'losses' collection would silently
     vanish without mutable=['losses'])."""
-    if getattr(dalle.cfg, "ff_experts", 0) > 1:
+    if dalle.cfg.ff_experts > 1:
         loss, state = dalle.apply(
             {"params": params}, text, codes, return_loss=True,
             deterministic=False, rngs={"dropout": rng}, mutable=["losses"])
