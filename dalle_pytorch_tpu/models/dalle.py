@@ -73,6 +73,12 @@ class DALLEConfig:
     ff_experts: int = 0        # >1: MoE FF with this many experts
     ff_expert_top_k: int = 2
     ff_aux_weight: float = 0.01  # load-balance aux loss weight in training
+    # dispatch mode is execution strategy over the SAME params: 'dense'
+    # (every expert sees every token, exact) or 'capacity' (GShard-style
+    # fixed slots, FLOPs ∝ top_k·capacity_factor instead of num_experts).
+    # Plan fields (below): excluded from checkpoints, CLI-selectable per run
+    ff_expert_dispatch: str = "dense"
+    ff_expert_capacity_factor: float = 1.25
     # Sequence-parallel execution plan (NOT model hyperparameters: the param
     # tree and the function are identical to the dense model; these only
     # select manual collectives inside a shard_map.  Excluded from to_dict
@@ -82,8 +88,10 @@ class DALLEConfig:
     sp_size: int = 1                 # ways of the sp axis (static shard count)
     dtype: Any = jnp.float32
 
-    # execution-plan fields stripped from checkpoint hparams (like dtype)
-    _PLAN_FIELDS = ("ring_axis", "sp_impl", "sp_size")
+    # execution-plan fields stripped from checkpoint hparams (like dtype):
+    # they select how the same params are computed, not what the model is
+    _PLAN_FIELDS = ("ring_axis", "sp_impl", "sp_size",
+                    "ff_expert_dispatch", "ff_expert_capacity_factor")
 
     @property
     def image_seq_len(self) -> int:
@@ -202,6 +210,8 @@ def transformer_kwargs(cfg: DALLEConfig) -> dict:
         pallas_block_k=cfg.pallas_block_k,
         ring_axis=cfg.ring_axis, sp_impl=cfg.sp_impl,
         ff_experts=cfg.ff_experts, ff_expert_top_k=cfg.ff_expert_top_k,
+        ff_expert_dispatch=cfg.ff_expert_dispatch,
+        ff_expert_capacity_factor=cfg.ff_expert_capacity_factor,
         dtype=cfg.dtype)
 
 

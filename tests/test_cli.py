@@ -235,19 +235,27 @@ def test_train_dalle_pipeline_cli(trained_vae, tiny_dataset,
     assert np.isfinite(_first_loss(wd))
 
 
+@pytest.mark.parametrize("dispatch_args", [
+    [],  # dense default
+    ["--ff_expert_dispatch", "capacity", "--ff_expert_capacity_factor", "2.0"],
+])
 def test_train_dalle_moe_cli(trained_vae, tiny_dataset, tiny_tokenizer_json,
-                             tmp_path_factory):
-    """`train_dalle.py --ff_experts 2` trains routed-MoE feed-forwards and
-    records the expert count in the checkpoint hparams (a model
-    hyperparameter, unlike the sp/pp execution plan)."""
+                             tmp_path_factory, dispatch_args):
+    """`train_dalle.py --ff_experts 2` trains routed-MoE feed-forwards in
+    both dispatch modes; the expert count is a checkpointed model
+    hyperparameter while the dispatch mode is per-run execution strategy
+    (same params) and stays out of the checkpoint."""
     wd = tmp_path_factory.mktemp("moe_cli")
     hp = dict(DALLE_HPARAMS, BATCH_SIZE=4, DEPTH=2)
-    _run_train_dalle(wd, hp, ["--ff_experts", "2", "--ff_expert_top_k", "1"],
+    _run_train_dalle(wd, hp,
+                     ["--ff_experts", "2", "--ff_expert_top_k", "1"]
+                     + dispatch_args,
                      trained_vae, tiny_dataset, tiny_tokenizer_json)
     from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
 
     ckpt = load_checkpoint(wd / "dalle-final.pt")
     assert ckpt["hparams"]["ff_experts"] == 2
+    assert "ff_expert_dispatch" not in ckpt["hparams"]  # plan, not identity
     ff = ckpt["weights"]["transformer"]["layers_0_ff"]
     assert "moe" in ff and ff["moe"]["w_in"].shape[0] == 2
     assert np.isfinite(_first_loss(wd))

@@ -117,6 +117,15 @@ def parse_args(argv=None):
                              'hyperparameter — stored in checkpoints)')
     parser.add_argument('--ff_expert_top_k', type=int, default=2,
                         help='experts routed per token when --ff_experts > 1')
+    parser.add_argument('--ff_expert_dispatch', choices=('dense', 'capacity'),
+                        default='dense',
+                        help="MoE dispatch: 'dense' (every expert sees every "
+                             "token, exact) or 'capacity' (GShard-style "
+                             "fixed slots; FLOPs scale with top_k x "
+                             "capacity factor instead of expert count)")
+    parser.add_argument('--ff_expert_capacity_factor', type=float,
+                        default=1.25,
+                        help="slot headroom for 'capacity' dispatch")
     parser = distributed_utils.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
     if args.stall_timeout and not args.heartbeat_dir:
@@ -221,6 +230,10 @@ def main(argv=None):
     if args.mesh_sp > 1:
         sp_plan = dict(ring_axis='sp', sp_impl=args.sp_impl,
                        sp_size=args.mesh_sp)
+    # MoE dispatch is also per-run execution strategy over the same params:
+    # CLI-selectable on fresh runs AND resumes (not stored in checkpoints)
+    sp_plan.update(ff_expert_dispatch=args.ff_expert_dispatch,
+                   ff_expert_capacity_factor=args.ff_expert_capacity_factor)
     pp_mode = args.pipeline_stages > 1
 
     tokenizer = select_tokenizer(args.bpe_path, chinese=args.chinese)
