@@ -15,7 +15,6 @@ replaces torch's ``DistributedSampler`` (`train_dalle.py:261-269`).
 """
 from __future__ import annotations
 
-import threading
 from pathlib import Path
 from typing import Iterator, Optional, Sequence
 
@@ -123,19 +122,28 @@ class TextImageDataset:
         self.resize_ratio = resize_ratio
         self.truncate_captions = truncate_captions
         self.seed = seed
-        self._counter = 0
-        self._lock = threading.Lock()
+        self.epoch = 0  # set by the DataLoader each epoch (set_epoch)
 
     def __len__(self):
         return len(self.keys)
 
+    def set_epoch(self, epoch: int) -> None:
+        """Epoch for plain ``ds[i]`` access (DistributedSampler-style).  The
+        DataLoader does NOT rely on this mutable state — it passes the epoch
+        explicitly via :meth:`item` at submit time, so overlapping iterators
+        / shared datasets cannot race the augmentation seeding."""
+        self.epoch = int(epoch)
+
     def __getitem__(self, idx: int):
+        return self.item(idx, self.epoch)
+
+    def item(self, idx: int, epoch: int):
         # fresh per-call Generator: numpy Generators are not thread-safe and
-        # __getitem__ runs concurrently under the prefetching DataLoader
-        with self._lock:
-            self._counter += 1
-            draw = self._counter
-        rng = np.random.default_rng((self.seed, idx, draw))
+        # this runs concurrently under the prefetching DataLoader.  Seeding
+        # by (seed, idx, epoch) — each index is visited once per epoch —
+        # makes augmentation reproducible across runs and thread schedules
+        # (a shared draw counter would depend on both).
+        rng = np.random.default_rng((self.seed, idx, epoch))
 
         # skip-bad-sample resilience: walk to a neighboring index rather than
         # aborting the epoch on one corrupt image / empty caption.
@@ -203,8 +211,17 @@ class DataLoader:
         per_host = n // self.shard_num_hosts
         return idx[self.shard_index * per_host : (self.shard_index + 1) * per_host]
 
+    def _fetch(self, idx: int, epoch: int):
+        """One item, with the epoch threaded explicitly into augmentation
+        seeding when the dataset supports it — captured per iterator, so
+        overlapping/abandoned iterators can't race each other's epochs."""
+        if hasattr(self.ds, "item"):
+            return self.ds.item(int(idx), epoch)
+        return self.ds[int(idx)]
+
     def __iter__(self) -> Iterator:
         indices = self._epoch_indices()
+        epoch = self.epoch
         self.epoch += 1
         batches = [
             indices[i : i + self.batch_size]
@@ -215,10 +232,10 @@ class DataLoader:
 
         if self.num_workers <= 0:
             for b in batches:
-                yield self._collate([self.ds[int(i)] for i in b])
+                yield self._collate([self._fetch(i, epoch) for i in b])
             return
 
-        yield from self._prefetch_iter(batches)
+        yield from self._prefetch_iter(batches, epoch)
 
     def _collate(self, items):
         from . import native
@@ -236,14 +253,14 @@ class DataLoader:
             return tuple(stack(c) for c in cols)
         return stack(items)
 
-    def _prefetch_iter(self, batches):
+    def _prefetch_iter(self, batches, epoch: int):
         """Ordered prefetch with real backpressure: at most `prefetch`
         batches are in flight; the consumer blocks on the next future."""
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
         def load(batch_idx):
-            return self._collate([self.ds[int(i)] for i in batch_idx])
+            return self._collate([self._fetch(i, epoch) for i in batch_idx])
 
         with ThreadPoolExecutor(max_workers=self.num_workers) as ex:
             pending = deque()

@@ -198,15 +198,13 @@ def test_train_dalle_sequence_parallel_cli(trained_vae, tiny_dataset,
     the identical phase CE; VERDICT round-1 item 3)."""
     wd_dense = tmp_path_factory.mktemp(f"sp_dense_{sp_impl}")
     wd_sp = tmp_path_factory.mktemp(f"sp_{sp_impl}")
-    # seq_len = 8 text + 16 image = 24, divisible by sp 4 and 2.
-    # crop ratio 1.0 => deterministic augmentation, so the dense run is an
-    # exact reference (the crop rng is otherwise thread-schedule dependent)
-    det = ["--random_resize_crop_lower_ratio", "1.0"]
+    # seq_len = 8 text + 16 image = 24, divisible by sp 4 and 2.  The crop
+    # rng is deterministic per (seed, idx, epoch), so the two runs see
+    # bit-identical batches and the dense run is an exact reference.
     hp = dict(DALLE_HPARAMS, BATCH_SIZE=4, DEPTH=2)
-    _run_train_dalle(wd_dense, hp, det, trained_vae, tiny_dataset,
+    _run_train_dalle(wd_dense, hp, [], trained_vae, tiny_dataset,
                      tiny_tokenizer_json)
-    _run_train_dalle(wd_sp, hp,
-                     det + ["--mesh_sp", str(sp), "--sp_impl", sp_impl],
+    _run_train_dalle(wd_sp, hp, ["--mesh_sp", str(sp), "--sp_impl", sp_impl],
                      trained_vae, tiny_dataset, tiny_tokenizer_json)
     assert (wd_sp / "dalle-final.pt").exists()
     # same data order (seeded shuffle), same init seed -> same first loss
