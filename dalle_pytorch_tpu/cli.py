@@ -21,10 +21,14 @@ from .models.dalle import generate_codes
 from .utils.checkpoint import load_checkpoint, migrate_qkv_kernels
 
 
-def enable_compilation_cache(path: Optional[str] = None) -> None:
+def enable_compilation_cache(path: Optional[str] = None,
+                             min_compile_secs: float = 1.0) -> None:
     """Persistent XLA compilation cache: TPU first-compiles run 20-40s, so
     CLI reruns (resume, generate sweeps, genrank over checkpoint lists)
-    should pay that once.  Off when DALLE_TPU_NO_COMPILE_CACHE is set."""
+    should pay that once.  Off when DALLE_TPU_NO_COMPILE_CACHE is set.
+    First configuration wins: a later call (e.g. a tool invoked in-process
+    by a test after tests/conftest.py configured the cache) never silently
+    retunes the threshold or redirects the directory."""
     import os
 
     if os.environ.get("DALLE_TPU_NO_COMPILE_CACHE"):
@@ -32,8 +36,15 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
     path = path or os.environ.get(
         "DALLE_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/dalle_tpu_xla"))
     try:
+        if jax.config.jax_compilation_cache_dir:
+            return  # already configured in this process: first wins
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+        # LRU-bound the on-disk cache: the persistent cache never evicts by
+        # default, so long-lived dev boxes / CI caches would accrete stale
+        # HLO entries forever (a full test-suite run writes ~8 MB)
+        jax.config.update("jax_compilation_cache_max_size", 256 * 2**20)
     except AttributeError as e:  # older jax without the knobs: run uncached
         import sys
 

@@ -277,11 +277,21 @@ def test_perf_ab_tool(monkeypatch, capsys):
             use_pallas=use_pallas, dtype=jnp.float32)
 
     monkeypatch.setattr(bench, "cub200_config", tiny_config)
+    seen_batches = {}
+    real_mtm = bench.make_train_measure
+
+    def spying_mtm(steps, batch=16, **overrides):
+        seen_batches[batch] = True
+        return real_mtm(steps, batch=batch, **overrides)
+
+    monkeypatch.setattr(bench, "make_train_measure", spying_mtm)
     assert perf_ab.main(["--list"]) == 0
-    assert perf_ab.main(["baseline", "full-attn", "--reps", "2",
+    assert perf_ab.main(["baseline", "full-attn", "batch64", "--reps", "2",
                          "--steps", "2"]) == 0
     out = capsys.readouterr().out
     assert "medians:" in out and "baseline" in out and "full-attn" in out
+    # the batch64 variant's override must actually reach make_train_measure
+    assert seen_batches == {16: True, 64: True}
 
 
 def test_perf_ab_rejects_bad_args(monkeypatch, capsys):

@@ -64,8 +64,11 @@ def main(argv=None):
     import jax.numpy as jnp
 
     from dalle_pytorch_tpu import DALLE, DALLEConfig
+    from dalle_pytorch_tpu.cli import enable_compilation_cache
     from dalle_pytorch_tpu.training import (make_dalle_train_step,
                                             make_optimizer)
+
+    enable_compilation_cache()  # a tunnel drop mid-run must not re-pay compile
 
     cfg = DALLEConfig(
         dim=256, num_text_tokens=7800, text_seq_len=80, depth=8, heads=8,

@@ -40,6 +40,12 @@ VARIANTS = {
     "bf16-logits": dict(logits_bf16=True),
     "onehot-embed": dict(onehot_embed=True),
     "bf16-logits+onehot": dict(logits_bf16=True, onehot_embed=True),
+    # batch-scaling A/B (PERF.md "Raising MFU" lever 1): `batch` binds to
+    # make_train_measure's batch param, not DALLEConfig; img/s stay
+    # comparable across batch sizes (items_per_step scales with the batch).
+    # Named batchN, not bN — the pallas-b64 suffix means block size.
+    "batch64": dict(batch=64),
+    "batch128": dict(batch=128),
 }
 
 
@@ -71,6 +77,9 @@ def main(argv=None) -> int:
                      "measurement slot; use --reps for repeated measurement")
 
     import bench
+    from dalle_pytorch_tpu.cli import enable_compilation_cache
+
+    enable_compilation_cache()  # variant recompiles across runs hit the cache
 
     measures = {}
     for name in args.variants:
