@@ -209,6 +209,15 @@ def _bwd_dkv_kernel(bsum_ref, q_ref, k_ref, v_ref, mask_ref, bias_ref,
 # ---------------------------------------------------------------------------
 
 
+# Every (batch*head, q-or-k-block) program in the three kernels below
+# writes its own disjoint output block exactly once (accumulation happens
+# only inside the per-program fori_loop), so both grid axes are parallel —
+# this lets Mosaic pipeline/reorder programs freely (megacore splits on
+# v4/v5p; no-op on single-tensorcore chips).
+_PARALLEL_GRID = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel"))
+
+
 def _smem_spec(shape):
     return pl.BlockSpec(shape, lambda ib, iq: (0, 0), memory_space=pltpu.SMEM)
 
@@ -248,6 +257,7 @@ def _call_fwd(q, k, v, mask, bsum, bias, *, scale, block_q, block_k,
             jax.ShapeDtypeStruct((bh, n_pad, dh), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, n_pad), jnp.float32),
         ],
+        compiler_params=_PARALLEL_GRID,
         interpret=interpret,
     )(bsum, q, k, v, mask, bias)
 
@@ -283,6 +293,7 @@ def _call_bwd(q, k, v, mask, bsum, bias, do, lse, delta, *, scale, block_q,
         out_specs=pl.BlockSpec((1, block_q, dh), lambda ib, iq: (ib, iq, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, n_pad, dh), q.dtype),
+        compiler_params=_PARALLEL_GRID,
         interpret=interpret,
     )(bsum, q, k, v, mask, bias, do, lse, delta)
 
@@ -316,6 +327,7 @@ def _call_bwd(q, k, v, mask, bsum, bias, do, lse, delta, *, scale, block_q,
             jax.ShapeDtypeStruct((bh, n_pad, dh), q.dtype),
             jax.ShapeDtypeStruct((bh, n_pad, dh), q.dtype),
         ],
+        compiler_params=_PARALLEL_GRID,
         interpret=interpret,
     )(bsum, q, k, v, mask, bias, do, lse, delta)
     return dq, dk, dv
