@@ -435,6 +435,17 @@ def main():
         except Exception as e:  # informational only — the JSON is already out
             print(f"{label} bench skipped: {e}", file=sys.stderr)
 
+    def hbm_stats():
+        return getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
+
+    bounded_stage(
+        "hbm-stats", hbm_stats,
+        lambda stats: ("device HBM in use after bench: "
+                       f"{stats['bytes_in_use'] / 2**30:.2f} GiB"
+                       + (f" (peak {stats['peak_bytes_in_use'] / 2**30:.2f}"
+                          " GiB)" if "peak_bytes_in_use" in stats else "")
+                       if "bytes_in_use" in stats  # absent on CPU/plugins
+                       else "device HBM stats unavailable"))
     bounded_stage(
         "generation", run_generate,
         lambda r: f"generation: {r[0]:.1f} image-tokens/sec "
