@@ -293,6 +293,19 @@ def test_perf_ab_tool(monkeypatch, capsys):
     # the batch64 variant's override must actually reach make_train_measure
     assert seen_batches == {16: True, 64: True}
 
+    seen_gen_batches = []
+    real_mgm = bench.make_gen_measure
+
+    def spying_mgm(batch=8):
+        seen_gen_batches.append(batch)
+        return real_mgm(batch=batch)
+
+    monkeypatch.setattr(bench, "make_gen_measure", spying_mgm)
+    assert perf_ab.main(["gen", "gen64", "--reps", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "tok/s" in out
+    assert seen_gen_batches == [8, 64]
+
 
 def test_perf_ab_rejects_bad_args(monkeypatch, capsys):
     from pathlib import Path

@@ -11,9 +11,11 @@ Usage:
     python tools/perf_ab.py --list
 
 Variants are train-step configs (see VARIANTS); `gen` measures the KV-cache
-sampler instead. The measured loops are bench.py's own
-(`make_train_measure` / `make_gen_measure`), so this tool can never drift
-from the driver-facing benchmark.
+sampler instead (`gen64` at batch 64 — the BASELINE target scenario samples
+64 images; `gen`'s batch 8 matches bench.py's informational stage).  The
+measured loops are bench.py's own (`make_train_measure` /
+`make_gen_measure`), so this tool can never drift from the driver-facing
+benchmark.
 """
 from __future__ import annotations
 
@@ -48,11 +50,15 @@ VARIANTS = {
     "batch128": dict(batch=128),
 }
 
+# pseudo-variants measuring other bench loops (not train-step configs)
+EXTRAS = ("gen", "gen64", "vae")
+
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("variants", nargs="*", default=[],
-                        help=f"from: {', '.join(VARIANTS)} , or 'gen'")
+                        help=f"from: {', '.join(VARIANTS)}, or "
+                             f"{'/'.join(EXTRAS)}")
     parser.add_argument("--reps", type=int, default=3,
                         help="interleaved measurement rounds (default 3)")
     parser.add_argument("--steps", type=int, default=30,
@@ -60,15 +66,15 @@ def main(argv=None) -> int:
     parser.add_argument("--list", action="store_true")
     args = parser.parse_args(argv)
     if args.list or not args.variants:
-        print("variants:", ", ".join(list(VARIANTS) + ["gen", "vae"]))
+        print("variants:", ", ".join(list(VARIANTS) + list(EXTRAS)))
         return 0
     if args.reps < 1:
         parser.error("--reps must be >= 1")
     unknown = [v for v in args.variants
-               if v not in ("gen", "vae") and v not in VARIANTS]
+               if v not in EXTRAS and v not in VARIANTS]
     if unknown:
         parser.error(f"unknown variant(s) {unknown}; choose from "
-                     f"{list(VARIANTS) + ['gen', 'vae']}")
+                     f"{list(VARIANTS) + list(EXTRAS)}")
     dupes = sorted({v for v in args.variants if args.variants.count(v) > 1})
     if dupes:
         # the measurement dict is keyed by name — a repeated variant would be
@@ -84,8 +90,9 @@ def main(argv=None) -> int:
     measures = {}
     for name in args.variants:
         print(f"compiling {name}...", file=sys.stderr, flush=True)
-        if name == "gen":
-            measures[name] = bench.make_gen_measure()
+        if name in ("gen", "gen64"):
+            measures[name] = bench.make_gen_measure(
+                batch=64 if name == "gen64" else 8)
         elif name == "vae":
             measures[name] = bench.make_vae_measure()
         else:
@@ -97,12 +104,12 @@ def main(argv=None) -> int:
         for name, measure in measures.items():  # interleaved round-robin
             v, _ = measure()
             results[name].append(v)
-            unit = "tok/s" if name == "gen" else "img/s"
+            unit = "tok/s" if name.startswith("gen") else "img/s"
             print(f"rep{rep} {name:12s} {v:9.2f} {unit}", flush=True)
 
     print("\nmedians:")
     for name, vals in results.items():
-        unit = "tok/s" if name == "gen" else "img/s"
+        unit = "tok/s" if name.startswith("gen") else "img/s"
         print(f"  {name:12s} {statistics.median(vals):9.2f} {unit}  "
               f"(spread {min(vals):.2f}-{max(vals):.2f})")
     return 0
