@@ -45,9 +45,12 @@ def device_peak_flops(default: float = 197e12) -> float:
 
 def transformer_train_flops(dim: int, depth: int, seq_len: int, heads: int,
                             dim_head: int, ff_mult: int, vocab: int,
-                            batch: int) -> float:
+                            batch: int,
+                            logits_flops: Optional[float] = None) -> float:
     """Analytic FLOPs for one *training* step (fwd + bwd ≈ 3x fwd) of a
-    GEGLU decoder stack + logits head, matmul terms only."""
+    GEGLU decoder stack + logits head, matmul terms only.  ``logits_flops``
+    overrides the forward head term for models whose head is not a single
+    ``seq_len x vocab`` matmul (e.g. DALLE's phase-sliced head)."""
     inner = heads * dim_head
     per_layer = (
         2 * seq_len * dim * (3 * inner)        # qkv projection
@@ -56,17 +59,34 @@ def transformer_train_flops(dim: int, depth: int, seq_len: int, heads: int,
         + 2 * seq_len * dim * (ff_mult * dim * 2)  # GEGLU in
         + 2 * seq_len * (ff_mult * dim) * dim      # ff out
     )
-    logits = 2 * seq_len * dim * vocab
+    logits = (2 * seq_len * dim * vocab if logits_flops is None
+              else logits_flops)
     fwd = depth * per_layer + logits
     return 3.0 * fwd * batch
 
 
 def dalle_train_flops(cfg, batch: int) -> float:
-    """FLOPs per train step for a DALLEConfig."""
+    """FLOPs per train step for a DALLEConfig.
+
+    Attention is counted dense (the convention sparse models quote MFU in,
+    and what the default dense-masked path actually executes), and the
+    logits head is counted as the phase-sliced matmuls the dense and
+    pipeline training losses really run (models/dalle.py::loss_from_hidden
+    slices positions before the head dot): ``text_seq_len`` positions x
+    text vocab (incl. per-position pads) + ``image_seq_len`` positions x
+    image vocab — not a ``seq_len x total_vocab`` product, which would
+    overstate FLOPs (and MFU) by ~9% at the CUB geometry.  The
+    sequence-parallel loss (``_sp_loss``) still executes the full-vocab
+    head per shard position (shards straddle the phase boundary at traced
+    offsets), so sp runs report conservatively: achieved FLOP/s/MFU there
+    understate executed work by the same ~9% rather than overstating it."""
+    logits_fwd = 2 * cfg.dim * (
+        cfg.text_seq_len * cfg.total_text_tokens
+        + cfg.image_seq_len * cfg.num_image_tokens)
     return transformer_train_flops(
         dim=cfg.dim, depth=cfg.depth, seq_len=cfg.seq_len + 1,
         heads=cfg.heads, dim_head=cfg.dim_head, ff_mult=4,
-        vocab=cfg.total_tokens, batch=batch)
+        vocab=cfg.total_tokens, batch=batch, logits_flops=logits_fwd)
 
 
 class StepTimer:

@@ -33,6 +33,30 @@ def test_flops_magnitude_sane():
     assert 0.5e12 < f < 5e12, f
 
 
+def test_flops_count_phase_sliced_head():
+    """The head term must match the phase-sliced matmuls the training loss
+    executes (models/dalle.py::loss_from_hidden) — NOT a dense
+    ``seq x total_vocab`` head, which overstates FLOPs/MFU by ~9% at the
+    CUB geometry.  Pins both the override plumbing and the exact term, so
+    a revert to dense-head accounting fails here."""
+    cfg = DALLEConfig(dim=256, num_text_tokens=7800, text_seq_len=80,
+                      depth=8, num_image_tokens=8192, image_size=256,
+                      image_fmap_size=32)
+    common = dict(dim=cfg.dim, depth=cfg.depth, seq_len=cfg.seq_len + 1,
+                  heads=cfg.heads, dim_head=cfg.dim_head, ff_mult=4,
+                  vocab=cfg.total_tokens, batch=16)
+    dense_head = transformer_train_flops(**common)
+    sliced = dalle_train_flops(cfg, 16)
+    sliced_head_fwd = 2 * cfg.dim * (
+        cfg.text_seq_len * cfg.total_text_tokens
+        + cfg.image_seq_len * cfg.num_image_tokens)
+    expected = transformer_train_flops(**common, logits_flops=sliced_head_fwd)
+    assert sliced == expected
+    # the sliced head must be a real reduction vs the dense-head count
+    assert sliced < dense_head
+    assert 0.05 < 1 - sliced / dense_head < 0.15
+
+
 def test_peak_flops_positive():
     assert device_peak_flops() > 0
 
