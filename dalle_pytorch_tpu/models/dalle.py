@@ -86,12 +86,20 @@ class DALLEConfig:
     ring_axis: Optional[str] = None  # mesh axis name, e.g. "sp"
     sp_impl: str = "ring"            # 'ring' | 'ulysses'
     sp_size: int = 1                 # ways of the sp axis (static shard count)
+    # Training-loss head strategy: True slices the head kernel per phase
+    # before the dot (skips the cross-phase half of the matmul, bit-identical
+    # loss).  Turn off under tensor parallelism: the slice boundary
+    # (total_text_tokens) does not align with tp shard boundaries on the
+    # vocab dim, so GSPMD would reshard the head kernel every step
+    # (train_dalle.py does this automatically for --mesh_tp > 1).
+    head_phase_sliced: bool = True
     dtype: Any = jnp.float32
 
     # execution-plan fields stripped from checkpoint hparams (like dtype):
     # they select how the same params are computed, not what the model is
     _PLAN_FIELDS = ("ring_axis", "sp_impl", "sp_size",
-                    "ff_expert_dispatch", "ff_expert_capacity_factor")
+                    "ff_expert_dispatch", "ff_expert_capacity_factor",
+                    "head_phase_sliced")
 
     @property
     def image_seq_len(self) -> int:
@@ -358,10 +366,17 @@ class DALLE(nn.Module):
         # this sliced head).
         T = cfg.text_seq_len
         # labels: next-token over [text[1:], image codes] (ref :489-499)
-        loss_text = self._phase_nll(self._head(out[:, :T], text_only=True),
+        if cfg.head_phase_sliced:
+            text_logits = self._head(out[:, :T], text_only=True)
+            img_logits = self._head(out[:, T:], image_only=True)
+        else:  # full head then slice — for tp meshes (see DALLEConfig)
+            logits = self._head(out)
+            V_text = cfg.total_text_tokens
+            text_logits = logits[:, :T, :V_text]
+            img_logits = logits[:, T:, V_text:]
+        loss_text = self._phase_nll(text_logits,
                                     self._remap_pad_tokens(text)).mean()
-        loss_img = self._phase_nll(self._head(out[:, T:], image_only=True),
-                                   image_codes).mean()
+        loss_img = self._phase_nll(img_logits, image_codes).mean()
         return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)
 
     def _sp_loss(self, text, image_codes, onehot: bool, deterministic: bool):

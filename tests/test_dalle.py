@@ -234,3 +234,17 @@ def test_generate_with_top_p(small):
                                      jax.random.PRNGKey(0), filter_thres=0.9,
                                      top_p=1.0))
     np.testing.assert_array_equal(plain, full)
+
+
+def test_full_head_loss_matches_sliced():
+    """head_phase_sliced=False (the tp-mesh execution plan: full head then
+    output slice) must produce the same loss as the default sliced-head
+    path — same math, different matmul partitioning."""
+    import dataclasses
+
+    cfg, dalle, params, text, codes = build()
+    assert cfg.head_phase_sliced
+    dalle_full = type(dalle)(dataclasses.replace(cfg, head_phase_sliced=False))
+    a = float(dalle.apply(params, text, codes, return_loss=True))
+    b = float(dalle_full.apply(params, text, codes, return_loss=True))
+    assert np.allclose(a, b, rtol=1e-6), (a, b)

@@ -64,3 +64,73 @@ def test_init_loss_matches_dvae_geometry():
     assert cfg.image_seq_len == 1024
     assert floor == pytest.approx(9.01, abs=0.01)
     assert floor - 0.05 < loss < floor + 0.7
+
+
+def test_loss_curve_chunked_dispatch_bit_identical(monkeypatch, tmp_path):
+    """tools/loss_curve.py's chunked lax.scan dispatch (the tunnel-friendly
+    mode) must produce the exact same `epoch iter loss lr` lines as an
+    INDEPENDENTLY-CODED per-step dispatch loop re-implementing the original
+    semantics (same step math, rng chain and per-epoch reshuffle) — and the
+    chunking must survive a chunk that straddles an epoch boundary.
+
+    The per-step reference here is deliberately NOT loss_curve's own code
+    path (with --chunk 1 both sides would share run_chunk, and a scan-body
+    regression would cancel out)."""
+    from pathlib import Path
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    monkeypatch.syspath_prepend(str(Path(__file__).resolve().parent.parent
+                                    / "tools"))
+    import dalle_pytorch_tpu as pkg
+    import loss_curve
+    from dalle_pytorch_tpu.training import make_dalle_train_step, make_optimizer
+
+    real_cfg = pkg.DALLEConfig
+
+    def tiny_cfg(**kw):
+        kw.update(dim=32, depth=2, heads=2, dim_head=16, text_seq_len=8,
+                  num_text_tokens=64, num_image_tokens=32, image_size=32,
+                  image_fmap_size=4, attn_types=("full",))
+        return real_cfg(**kw)
+
+    monkeypatch.setattr(pkg, "DALLEConfig", tiny_cfg)
+    # num_pairs 64 / batch 4 -> 16 iters/epoch; steps 20 with chunk 8 makes
+    # the third chunk [16, 24) straddle the epoch-0/epoch-1 boundary, so the
+    # per-epoch reshuffle inside the chunk gatherer is exercised
+    steps, num_pairs, batch, seed, lr = 20, 64, 4, 0, 3e-4
+    out = tmp_path / "chunked.txt"
+    loss_curve.main(["--steps", str(steps), "--num_pairs", str(num_pairs),
+                     "--batch_size", str(batch), "--chunk", "8",
+                     "--out", str(out)])
+
+    # independent per-step reference (the original dispatch semantics)
+    cfg = tiny_cfg(dim=256)  # kwargs overridden by tiny_cfg, like main()
+    model = pkg.DALLE(cfg)
+    host = np.random.default_rng(seed)
+    caps, codes = loss_curve.make_synthetic_pairs(
+        host, num_pairs, cfg.text_seq_len, cfg.num_text_tokens,
+        cfg.image_seq_len, cfg.num_image_tokens)
+    rng = jax.random.PRNGKey(seed)
+    params = jax.jit(lambda r: model.init(
+        r, jnp.asarray(caps[:1]), jnp.asarray(codes[:1]))["params"])(rng)
+    tx = make_optimizer(lr)
+    opt_state = jax.jit(tx.init)(params)
+    step_fn = make_dalle_train_step(model, tx)
+    lines = []
+    iters_per_epoch = num_pairs // batch
+    order = None
+    for step in range(steps):
+        epoch, it = divmod(step, iters_per_epoch)
+        if it == 0:
+            order = np.random.default_rng(seed + epoch).permutation(num_pairs)
+        sel = order[it * batch:(it + 1) * batch]
+        rng, k = jax.random.split(rng)
+        params, opt_state, loss = step_fn(params, opt_state, None,
+                                          jnp.asarray(caps[sel]),
+                                          jnp.asarray(codes[sel]), k)
+        lines.append(f"{epoch} {it} {float(loss)} {lr}")
+
+    assert out.read_text().splitlines() == lines

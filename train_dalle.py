@@ -234,6 +234,12 @@ def main(argv=None):
     # CLI-selectable on fresh runs AND resumes (not stored in checkpoints)
     sp_plan.update(ff_expert_dispatch=args.ff_expert_dispatch,
                    ff_expert_capacity_factor=args.ff_expert_capacity_factor)
+    if args.mesh_tp > 1:
+        # phase-slicing the head kernel cuts the vocab dim at
+        # total_text_tokens, which doesn't align with tp shard boundaries —
+        # GSPMD would reshard the head every step; full-head + output slice
+        # keeps the kernel evenly tp-sharded (see DALLEConfig)
+        sp_plan.update(head_phase_sliced=False)
     pp_mode = args.pipeline_stages > 1
 
     tokenizer = select_tokenizer(args.bpe_path, chinese=args.chinese)
