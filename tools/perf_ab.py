@@ -42,6 +42,9 @@ VARIANTS = {
     "bf16-logits": dict(logits_bf16=True),
     "onehot-embed": dict(onehot_embed=True),
     "bf16-logits+onehot": dict(logits_bf16=True, onehot_embed=True),
+    # measures the phase-sliced-head default against the old full-head +
+    # output-slice path (same loss; ~9% fewer analytic step FLOPs)
+    "full-head": dict(head_phase_sliced=False),
     # batch-scaling A/B (PERF.md "Raising MFU" lever 1): `batch` binds to
     # make_train_measure's batch param, not DALLEConfig; img/s stay
     # comparable across batch sizes (items_per_step scales with the batch).
