@@ -8,9 +8,19 @@ set -eu
 LIST="${1:?usage: rank_models.sh <ckpt-list.txt> <caption> [genrank args...]}"
 CAPTION="${2:?missing caption}"
 shift 2
+# the reference times with /usr/bin/time -p (ref rank_models.sh:1-2);
+# fall back to bash's `time` keyword where GNU time isn't installed
+run_timed() {
+    if [ -x /usr/bin/time ]; then
+        /usr/bin/time -p "$@"
+    else
+        time -p "$@"
+    fi
+}
+
 while IFS= read -r ckpt; do
     [ -z "$ckpt" ] && continue
     echo "=== ranking $ckpt ==="
-    /usr/bin/time -p python genrank.py --dalle_path "$ckpt" \
+    run_timed python genrank.py --dalle_path "$ckpt" \
         --text "$CAPTION" --num_images 512 "$@"
 done < "$LIST"
