@@ -333,3 +333,66 @@ def test_vae_measure_tiny(monkeypatch):
     measure = bench.make_vae_measure(steps=2, batch=2)
     ips, dt = measure()
     assert ips > 0 and dt > 0
+
+
+def test_collect_ab_parses_medians(tmp_path, capsys, monkeypatch):
+    """tools/collect_ab.py turns perf_ab logs into one markdown table,
+    skipping failed/truncated stages but still collecting the rest."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).resolve().parent.parent
+                                    / "tools"))
+    import collect_ab
+
+    good = tmp_path / "chip_ab_core.log"
+    good.write_text(
+        "compiling baseline...\n"
+        "rep0 baseline      100.00 img/s\n"
+        "rep0 full-head      90.00 img/s\n"
+        "\nmedians:\n"
+        "  baseline       101.50 img/s  (spread 100.00-103.00)\n"
+        "  full-head       90.00 img/s  (spread 88.00-91.00)\n")
+    gen = tmp_path / "chip_gen.log"
+    gen.write_text("\nmedians:\n"
+                   "  gen           8400.00 tok/s  (spread 8300.00-8500.00)\n")
+    bad = tmp_path / "chip_ab_pallas.log"
+    bad.write_text("compiling pallas...\nTimeoutError: tunnel hang\n")
+
+    rc = collect_ab.main([str(good), str(gen), str(bad),
+                          str(tmp_path / "missing.log")])
+    assert rc == 0
+    out = capsys.readouterr()
+    table = out.out.splitlines()
+    assert table[0].startswith("| run | variant")
+    assert "| ab_core | baseline | 101.50 img/s | 100.00-103.00 |" in table
+    assert "| ab_core | full-head | 90.00 img/s | 88.00-91.00 |" in table
+    assert "| gen | gen | 8400.00 tok/s | 8300.00-8500.00 |" in table
+    assert "ab_pallas" not in out.out  # failed stage skipped...
+    assert "no medians block" in out.err  # ...but reported
+    assert "no such file" in out.err
+
+    # no inputs / nothing parsable -> distinct exit codes
+    assert collect_ab.main([]) == 2
+    assert collect_ab.main([str(bad)]) == 1
+
+
+def test_collect_ab_same_named_logs_both_kept(tmp_path, capsys, monkeypatch):
+    """Two logs with the same filename (different run dirs) must both land
+    in the table, not silently overwrite each other."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).resolve().parent.parent
+                                    / "tools"))
+    import collect_ab
+
+    block = ("\nmedians:\n"
+             "  baseline       {v:.2f} img/s  (spread {v:.2f}-{v:.2f})\n")
+    a = tmp_path / "runA" / "chip_ab_core.log"
+    b = tmp_path / "runB" / "chip_ab_core.log"
+    a.parent.mkdir(); b.parent.mkdir()
+    a.write_text(block.format(v=100.0))
+    b.write_text(block.format(v=200.0))
+    assert collect_ab.main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "| ab_core | baseline | 100.00 img/s" in out
+    assert "| ab_core' | baseline | 200.00 img/s" in out
