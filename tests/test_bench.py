@@ -396,3 +396,39 @@ def test_collect_ab_same_named_logs_both_kept(tmp_path, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert "| ab_core | baseline | 100.00 img/s" in out
     assert "| ab_core' | baseline | 200.00 img/s" in out
+
+
+def test_history_recorded_on_chip_not_on_cpu(monkeypatch, tmp_path, capsys):
+    """A successful main() appends a self-describing line to the bench
+    history on real chips, and never from CPU runs (tests/dev smoke)."""
+    import json
+    import types
+
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import DALLEConfig
+
+    cfg = DALLEConfig(dim=32, num_text_tokens=64, text_seq_len=8, depth=2,
+                      heads=2, dim_head=16, attn_types=("full",),
+                      num_image_tokens=32, image_size=32, image_fmap_size=4,
+                      dtype=jnp.float32)
+    hist = tmp_path / "hist.jsonl"
+    monkeypatch.setenv("BENCH_HISTORY", str(hist))
+    monkeypatch.setattr(bench, "_run_with_retry",
+                        lambda: (42.5, 1.0, cfg, 16, bench.STEPS, 1))
+    monkeypatch.setattr(bench, "run_generate", lambda: (1.0, 1.0))
+
+    # CPU platform (the suite's environment): no history line
+    bench.main()
+    assert not hist.exists()
+
+    # fake chip platform: one appended, self-describing line
+    fake = types.SimpleNamespace(platform="tpu", device_kind="TPU v5 lite",
+                                 memory_stats=lambda: None)
+    monkeypatch.setattr(bench.jax, "devices", lambda: [fake])
+    bench.main()
+    capsys.readouterr()
+    (line,) = hist.read_text().splitlines()
+    rec = json.loads(line)
+    assert rec["value"] == 42.5 and rec["device"] == "TPU v5 lite"
+    assert rec["mfu"] >= 0 and rec["tflops"] >= 0 and "ts" in rec
