@@ -38,17 +38,43 @@ def enable_compilation_cache(path: Optional[str] = None,
     try:
         if jax.config.jax_compilation_cache_dir:
             return  # already configured in this process: first wins
-        jax.config.update("jax_compilation_cache_dir", path)
+        # the dir knob goes LAST: it is the on/off switch, so a partial
+        # configuration (an older jax missing one of the optional knobs
+        # below) must leave the cache off — making the except-branch's
+        # "run uncached" message true rather than leaving an enabled,
+        # unbounded cache behind
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           min_compile_secs)
         # LRU-bound the on-disk cache: the persistent cache never evicts by
         # default, so long-lived dev boxes / CI caches would accrete stale
         # HLO entries forever (a full test-suite run writes ~8 MB)
         jax.config.update("jax_compilation_cache_max_size", 256 * 2**20)
+        jax.config.update("jax_compilation_cache_dir", path)
     except AttributeError as e:  # older jax without the knobs: run uncached
         import sys
 
         print(f"compilation cache unavailable: {e}", file=sys.stderr)
+
+
+def apply_platform_env() -> None:
+    """Re-assert the ``JAX_PLATFORMS`` env var over a sitecustomize-registered
+    PJRT plugin.
+
+    The axon TPU tunnel's ``register()`` (run from sitecustomize at
+    interpreter start) pins ``jax_platforms`` to the tunnel backend
+    in-process, which silently overrides a ``JAX_PLATFORMS=cpu`` passed in
+    the environment — and when the tunnel is wedged, backend init then
+    hangs forever inside the first ``jax.devices()`` with no exception.
+    CPU-only tools (loss curves, tests, converters) call this right after
+    importing jax so the documented env contract holds; when the env var
+    is unset (TPU runs under the ambient ``JAX_PLATFORMS=axon``) this is
+    a no-op.
+    """
+    import os
+
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        jax.config.update("jax_platforms", p)
 
 
 def select_tokenizer(bpe_path: Optional[str], chinese: bool = False):

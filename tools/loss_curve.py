@@ -13,12 +13,23 @@ selects a code template, observed under token noise — so the loss must fall
 from the ~7.4 init toward the template entropy, exercising the identical
 train step the real run uses (training.make_dalle_train_step, codes path).
 
+Two additions over the bare harness mirror the real training loop:
+* ``--lr_plateau`` steps the same host-side ``ReduceLROnPlateau`` that
+  train_dalle.py uses (ref train_dalle.py:286-295, :415-416) on each
+  epoch-mean loss, and the logged lr column carries the *actual* lr — so a
+  multi-epoch run shows the scheduler firing, like the reference's logs.
+* ``--ckpt`` (on by default, derived from --out) saves {params, opt state,
+  rng, scheduler} after every chunk and resumes from it on restart — a
+  tunnel drop mid-run costs one chunk, not the run.
+
 Usage:
     python tools/loss_curve.py --steps 400 --out all-logs-tpu/synthetic-cub.txt
 """
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -48,6 +59,13 @@ def make_synthetic_pairs(rng, num_pairs, text_len, vocab, image_seq,
     return caps.astype(np.int32), codes.astype(np.int32)
 
 
+def _config_sig(args):
+    """Fields that must match for a checkpoint to be resumable."""
+    return {k: getattr(args, k) for k in
+            ("batch_size", "learning_rate", "num_pairs", "seed", "templates",
+             "noise", "lr_plateau", "plateau_factor", "plateau_patience")}
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--steps", type=int, default=400)
@@ -55,8 +73,18 @@ def main(argv=None):
     parser.add_argument("--learning_rate", type=float, default=3e-4)
     parser.add_argument("--num_pairs", type=int, default=10464,
                         help="654 iters/epoch x batch 16, as cool-frog-21")
+    parser.add_argument("--templates", type=int, default=32)
+    parser.add_argument("--noise", type=float, default=0.1)
+    parser.add_argument("--lr_plateau", action="store_true",
+                        help="step ReduceLROnPlateau on each epoch-mean "
+                             "loss, as train_dalle.py does (ref :415-416)")
+    parser.add_argument("--plateau_factor", type=float, default=0.5)
+    parser.add_argument("--plateau_patience", type=int, default=5)
     parser.add_argument("--out", type=str,
                         default="all-logs-tpu/synthetic-cub.txt")
+    parser.add_argument("--ckpt", type=str, default=None,
+                        help="checkpoint path (default: <out>.ckpt); "
+                             "'' disables")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--chunk", type=int, default=50,
                         help="steps per device dispatch: a lax.scan over "
@@ -68,12 +96,17 @@ def main(argv=None):
 
     import jax
     import jax.numpy as jnp
+    from flax import serialization
 
     from dalle_pytorch_tpu import DALLE, DALLEConfig
-    from dalle_pytorch_tpu.cli import enable_compilation_cache
+    from dalle_pytorch_tpu.cli import (apply_platform_env,
+                                       enable_compilation_cache)
     from dalle_pytorch_tpu.training import (make_dalle_train_step,
-                                            make_optimizer)
+                                            make_optimizer,
+                                            set_learning_rate)
+    from dalle_pytorch_tpu.utils.schedule import ReduceLROnPlateau
 
+    apply_platform_env()  # honor JAX_PLATFORMS=cpu despite the axon pin
     enable_compilation_cache()  # a tunnel drop mid-run must not re-pay compile
 
     cfg = DALLEConfig(
@@ -87,16 +120,60 @@ def main(argv=None):
     host = np.random.default_rng(args.seed)
     caps, codes = make_synthetic_pairs(
         host, args.num_pairs, cfg.text_seq_len, cfg.num_text_tokens,
-        cfg.image_seq_len, cfg.num_image_tokens)
+        cfg.image_seq_len, cfg.num_image_tokens,
+        templates=args.templates, noise=args.noise)
 
     rng = jax.random.PRNGKey(args.seed)
     params = jax.jit(lambda r: model.init(
         r, jnp.asarray(caps[:1]), jnp.asarray(codes[:1]))["params"])(rng)
     tx = make_optimizer(args.learning_rate)
     opt_state = jax.jit(tx.init)(params)
+    sched = ReduceLROnPlateau(args.learning_rate, factor=args.plateau_factor,
+                              patience=args.plateau_patience)
 
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
+    ckpt = Path(args.ckpt) if args.ckpt else (
+        None if args.ckpt == "" else out.with_suffix(out.suffix + ".ckpt"))
+
+    # ---- resume ---------------------------------------------------------
+    # single-file checkpoint: {params, opt_state, meta-json} in ONE msgpack
+    # blob behind ONE os.replace — a crash can only ever leave the previous
+    # complete checkpoint, never a params/meta mismatch
+    start_step = 0
+    epoch_sum, epoch_cnt = 0.0, 0  # running epoch-mean accumulator
+    if ckpt is not None and ckpt.exists():
+        state = serialization.from_bytes(
+            {"params": params, "opt_state": opt_state, "meta": ""},
+            ckpt.read_bytes())
+        meta = json.loads(state["meta"])
+        log_lines = (out.read_text().splitlines(keepends=True)
+                     if out.exists() else [])
+        if meta["sig"] != _config_sig(args):
+            print(f"checkpoint {ckpt} config mismatch; starting fresh",
+                  flush=True)
+        elif len(log_lines) < meta["next_step"]:
+            # the log this checkpoint continues is gone/truncated (e.g. a
+            # reused --ckpt with a fresh --out): resuming would produce a
+            # file silently missing its head
+            print(f"log {out} has {len(log_lines)} lines < checkpoint step "
+                  f"{meta['next_step']}; starting fresh", flush=True)
+        else:
+            params, opt_state = state["params"], state["opt_state"]
+            rng = jnp.asarray(np.asarray(meta["rng"], dtype=np.uint32))
+            sched.load_state_dict(meta["sched"])
+            opt_state = set_learning_rate(opt_state, sched.lr)
+            start_step = meta["next_step"]
+            epoch_sum, epoch_cnt = meta["epoch_sum"], meta["epoch_cnt"]
+            # drop any log lines past the checkpoint (died between write
+            # and save): keep exactly start_step lines
+            out.write_text("".join(log_lines[:start_step]))
+            print(f"resumed from {ckpt} at step {start_step} "
+                  f"(lr {sched.lr:.2e})", flush=True)
+
+    if start_step == 0 and out.exists():
+        out.unlink()
+
     iters_per_epoch = args.num_pairs // args.batch_size
     chunk = max(1, args.chunk)
     raw_step = make_dalle_train_step(model, tx, jit=False)
@@ -129,11 +206,31 @@ def main(argv=None):
                 args.num_pairs))
         return epoch, it, order[it * args.batch_size:(it + 1) * args.batch_size]
 
+    def save_ckpt(next_step):
+        if ckpt is None:
+            return
+        meta = {"sig": _config_sig(args), "next_step": next_step,
+                "rng": np.asarray(jax.device_get(rng)).tolist(),
+                "sched": sched.state_dict(),
+                "epoch_sum": epoch_sum, "epoch_cnt": epoch_cnt}
+        tmp = ckpt.with_suffix(".tmp")
+        tmp.write_bytes(serialization.to_bytes(
+            {"params": jax.device_get(params),
+             "opt_state": jax.device_get(opt_state),
+             "meta": json.dumps(meta)}))
+        os.replace(tmp, ckpt)
+
     epoch_orders = {}
     t0 = time.time()
-    with out.open("w") as f:
-        for start in range(0, args.steps, chunk):
-            n = min(chunk, args.steps - start)
+    done_before = start_step
+    with out.open("a") as f:
+        start = start_step
+        while start < args.steps:
+            # never let a chunk cross an epoch boundary: the plateau step
+            # (and its lr change) belongs between epochs, as in the loop it
+            # mirrors (train_dalle.py:722-725)
+            it0 = start % iters_per_epoch
+            n = min(chunk, args.steps - start, iters_per_epoch - it0)
             meta, sels = [], []
             for step in range(start, start + n):
                 epoch, it, sel = batch_indices(step)
@@ -146,10 +243,22 @@ def main(argv=None):
             host_losses = jax.device_get(losses)  # one transfer per chunk
             for (epoch, it), loss_v in zip(meta, host_losses):
                 # the reference's exact line format (ref train_dalle.py:378)
-                f.write(f"{epoch} {it} {float(loss_v)} {args.learning_rate}\n")
+                f.write(f"{epoch} {it} {float(loss_v)} {sched.lr}\n")
             f.flush()
-            rate = (start + n) / (time.time() - t0)
-            print(f"step {start + n - 1}: loss {float(host_losses[-1]):.4f} "
+            epoch_sum += float(host_losses.sum())
+            epoch_cnt += n
+            start += n
+            if args.lr_plateau and start % iters_per_epoch == 0:
+                epoch_mean = epoch_sum / max(epoch_cnt, 1)
+                new_lr = sched.step(epoch_mean)
+                opt_state = set_learning_rate(opt_state, new_lr)
+                print(f"epoch {start // iters_per_epoch - 1} done: "
+                      f"mean loss {epoch_mean:.4f} lr {new_lr:.2e}",
+                      flush=True)
+                epoch_sum, epoch_cnt = 0.0, 0
+            save_ckpt(start)
+            rate = (start - done_before) / (time.time() - t0)
+            print(f"step {start - 1}: loss {float(host_losses[-1]):.4f} "
                   f"({rate:.2f} steps/s)", flush=True)
     print(f"wrote {args.steps} lines to {out}")
 
