@@ -57,7 +57,7 @@ def enable_compilation_cache(path: Optional[str] = None,
 
 
 def apply_platform_env() -> None:
-    """Re-assert the ``JAX_PLATFORMS`` env var over a sitecustomize-registered
+    """Honor an explicit ``JAX_PLATFORMS=cpu`` over a sitecustomize-registered
     PJRT plugin.
 
     The axon TPU tunnel's ``register()`` (run from sitecustomize at
@@ -65,15 +65,20 @@ def apply_platform_env() -> None:
     in-process, which silently overrides a ``JAX_PLATFORMS=cpu`` passed in
     the environment — and when the tunnel is wedged, backend init then
     hangs forever inside the first ``jax.devices()`` with no exception.
-    CPU-only tools (loss curves, tests, converters) call this right after
-    importing jax so the documented env contract holds; when the env var
-    is unset (TPU runs under the ambient ``JAX_PLATFORMS=axon``) this is
-    a no-op.
+    CPU-only tools (loss curves, converters) call this right after
+    importing jax so the documented env contract holds.
+
+    Deliberately one-directional: only a cpu-first env value is applied.
+    The ambient environment carries ``JAX_PLATFORMS=axon`` everywhere, so
+    re-applying a non-cpu value would *undo* an in-process
+    ``jax.config.update("jax_platforms", "cpu")`` made by a host that then
+    calls a tool's main() (tests/conftest.py does exactly that) — flipping
+    the suite onto the tunnel backend mid-run.
     """
     import os
 
-    p = os.environ.get("JAX_PLATFORMS")
-    if p:
+    p = os.environ.get("JAX_PLATFORMS", "")
+    if p.split(",")[0].strip() == "cpu":
         jax.config.update("jax_platforms", p)
 
 

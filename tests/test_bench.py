@@ -134,14 +134,20 @@ def test_stages_refuse_while_attempt_wedged(monkeypatch, capsys):
 
     ran_stage = {"gen": False}
     monkeypatch.setattr(bench, "_run_with_retry", retry_with_wedge)
-    monkeypatch.setattr(bench, "run_generate",
-                        lambda: ran_stage.__setitem__("gen", True) or (1.0, 1.0))
+
+    def fake_deferred(batch=8):
+        def compile_fn():
+            ran_stage["gen"] = True
+            return lambda: (1.0, 1.0)
+        return compile_fn, cfg
+
+    monkeypatch.setattr(bench, "make_gen_measure_deferred", fake_deferred)
     try:
         bench.main()
     finally:
         release.set()
     captured = capsys.readouterr()
-    assert "generation bench skipped" in captured.err
+    assert "generation-b8-compile bench skipped" in captured.err
     assert "wedged" in captured.err
     assert not ran_stage["gen"]
     # the JSON still went out despite the wedge
@@ -244,9 +250,13 @@ def test_main_emits_json_before_stages(monkeypatch, capsys):
                       dtype=jnp.float32)
     monkeypatch.setattr(bench, "_run_with_retry",
                         lambda: (42.5, 1.0, cfg, 16, bench.FIRST_STEPS, 1))
-    monkeypatch.setattr(
-        bench, "run_generate",
-        lambda: (_ for _ in ()).throw(RuntimeError("stage boom")))
+
+    def boom_deferred(batch=8):
+        def compile_fn():
+            raise RuntimeError("stage boom")
+        return compile_fn, cfg
+
+    monkeypatch.setattr(bench, "make_gen_measure_deferred", boom_deferred)
     bench.main()
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
@@ -416,7 +426,11 @@ def test_history_recorded_on_chip_not_on_cpu(monkeypatch, tmp_path, capsys):
     monkeypatch.setenv("BENCH_HISTORY", str(hist))
     monkeypatch.setattr(bench, "_run_with_retry",
                         lambda: (42.5, 1.0, cfg, 16, bench.STEPS, 1))
-    monkeypatch.setattr(bench, "run_generate", lambda: (1.0, 1.0))
+
+    def fast_deferred(batch=8):
+        return (lambda: (lambda: (1.0, 1.0))), cfg
+
+    monkeypatch.setattr(bench, "make_gen_measure_deferred", fast_deferred)
 
     # CPU platform (the suite's environment): no history line
     bench.main()

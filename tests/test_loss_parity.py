@@ -97,9 +97,10 @@ def test_loss_curve_chunked_dispatch_bit_identical(monkeypatch, tmp_path):
         return real_cfg(**kw)
 
     monkeypatch.setattr(pkg, "DALLEConfig", tiny_cfg)
-    # num_pairs 64 / batch 4 -> 16 iters/epoch; steps 20 with chunk 8 makes
-    # the third chunk [16, 24) straddle the epoch-0/epoch-1 boundary, so the
-    # per-epoch reshuffle inside the chunk gatherer is exercised
+    # num_pairs 64 / batch 4 -> 16 iters/epoch; steps 20 with chunk 8 would
+    # put the third chunk at [16, 24), which the epoch-boundary clamp splits
+    # into [16, 16+4) — so both the clamp and the post-boundary reshuffle
+    # are exercised against the reference loop's per-step reshuffle
     steps, num_pairs, batch, seed, lr = 20, 64, 4, 0, 3e-4
     out = tmp_path / "chunked.txt"
     loss_curve.main(["--steps", str(steps), "--num_pairs", str(num_pairs),
@@ -134,3 +135,71 @@ def test_loss_curve_chunked_dispatch_bit_identical(monkeypatch, tmp_path):
         lines.append(f"{epoch} {it} {float(loss)} {lr}")
 
     assert out.read_text().splitlines() == lines
+
+
+def _tiny_cfg_patch(monkeypatch):
+    import dalle_pytorch_tpu as pkg
+
+    real_cfg = pkg.DALLEConfig
+
+    def tiny_cfg(**kw):
+        kw.update(dim=32, depth=2, heads=2, dim_head=16, text_seq_len=8,
+                  num_text_tokens=64, num_image_tokens=32, image_size=32,
+                  image_fmap_size=4, attn_types=("full",))
+        return real_cfg(**kw)
+
+    monkeypatch.setattr(pkg, "DALLEConfig", tiny_cfg)
+
+
+def test_loss_curve_resume_bit_identical(monkeypatch, tmp_path):
+    """Kill-and-resume must reproduce the uninterrupted run exactly: the
+    checkpoint carries params/opt/rng/scheduler and the log is continued,
+    so the multi-hour artifacts the resume path protects cannot silently
+    diverge after a tunnel drop."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).resolve().parent.parent
+                                    / "tools"))
+    _tiny_cfg_patch(monkeypatch)
+    import loss_curve
+
+    common = ["--num_pairs", "64", "--batch_size", "4", "--chunk", "4",
+              "--lr_plateau", "--ckpt_every_s", "0"]
+    out = tmp_path / "resumed.txt"
+    # first leg stops mid-epoch (step 10 of 16-iter epochs)
+    loss_curve.main(["--steps", "10", "--out", str(out)] + common)
+    assert out.with_suffix(".txt.ckpt").exists()
+    # second leg resumes from the checkpoint and finishes
+    loss_curve.main(["--steps", "20", "--out", str(out)] + common)
+
+    fresh = tmp_path / "fresh.txt"
+    loss_curve.main(["--steps", "20", "--out", str(fresh), "--ckpt", ""]
+                    + common)
+    assert out.read_text() == fresh.read_text()
+
+
+def test_loss_curve_plateau_lr_lands_in_log(monkeypatch, tmp_path):
+    """The logged lr column must carry the ReduceLROnPlateau output: with
+    lr=0 the params never change, so epoch means repeat EXACTLY, the
+    plateau (patience 0) fires at the first epoch end, and every epoch-1
+    line must show min_lr instead of the initial lr."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).resolve().parent.parent
+                                    / "tools"))
+    _tiny_cfg_patch(monkeypatch)
+    import loss_curve
+
+    out = tmp_path / "plateau.txt"
+    loss_curve.main(["--steps", "48", "--num_pairs", "64", "--batch_size",
+                     "4", "--chunk", "16", "--learning_rate", "0.0",
+                     "--lr_plateau", "--plateau_patience", "0",
+                     "--out", str(out), "--ckpt", ""])
+    rows = [line.split() for line in out.read_text().splitlines()]
+    assert len(rows) == 48
+    lrs_by_epoch = {e: {r[3] for r in rows if r[0] == e} for e in "012"}
+    # epoch 0 ends with best=inf improved (no fire); epoch 1's identical
+    # mean is the first bad epoch -> fire lands in epoch 2's lines
+    assert lrs_by_epoch["0"] == {"0.0"}
+    assert lrs_by_epoch["1"] == {"0.0"}
+    assert lrs_by_epoch["2"] == {"1e-07"}  # factor*0 floored at min_lr
