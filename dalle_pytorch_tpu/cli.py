@@ -18,7 +18,8 @@ import numpy as np
 from . import DALLE, DALLEConfig, DiscreteVAE, VAEConfig
 from .data.tokenizer import ChineseTokenizer, HugTokenizer, SimpleTokenizer
 from .models.dalle import generate_codes
-from .utils.checkpoint import load_checkpoint, migrate_qkv_kernels
+from .utils.checkpoint import (load_checkpoint, migrate_head_kernels,
+                               migrate_qkv_kernels)
 
 
 def enable_compilation_cache(path: Optional[str] = None,
@@ -128,6 +129,7 @@ def load_dalle_checkpoint(dalle_path: str | Path, taming: bool = False):
     cfg = DALLEConfig.from_dict(dalle_params)
     dalle = DALLE(cfg)
     weights = migrate_qkv_kernels(ckpt['weights'], dim_head=cfg.dim_head)
+    weights = migrate_head_kernels(weights, cfg.total_text_tokens)
     params = jax.tree.map(jnp.asarray, weights)
     return dalle, cfg, params, vae, vae_params
 

@@ -86,12 +86,12 @@ class DALLEConfig:
     ring_axis: Optional[str] = None  # mesh axis name, e.g. "sp"
     sp_impl: str = "ring"            # 'ring' | 'ulysses'
     sp_size: int = 1                 # ways of the sp axis (static shard count)
-    # Training-loss head strategy: True slices the head kernel per phase
-    # before the dot (skips the cross-phase half of the matmul, bit-identical
-    # loss).  Turn off under tensor parallelism: the slice boundary
-    # (total_text_tokens) does not align with tp shard boundaries on the
-    # vocab dim, so GSPMD would reshard the head kernel every step
-    # (train_dalle.py does this automatically for --mesh_tp > 1).
+    # Training-loss head strategy: True runs one matmul per vocab phase
+    # (text positions x text head, image positions x image head — skips the
+    # cross-phase half of the compute, bit-identical loss).  False computes
+    # both phases for every position then slices (the A/B control).  The
+    # head is stored per-phase either way (PhaseLogits), so tp meshes keep
+    # the sliced path: each phase kernel tp-shards on its own vocab dim.
     head_phase_sliced: bool = True
     dtype: Any = jnp.float32
 
@@ -147,20 +147,33 @@ class DALLEConfig:
 
 
 class PhaseLogits(nn.Module):
-    """The joint-vocab logits head, with sliced per-phase fast paths.
+    """The joint-vocab logits head, stored as one kernel PER VOCAB PHASE.
 
-    Parameter tree is identical to the ``nn.Dense(total_tokens)`` it
-    replaces (kernel [dim, total], bias [total]) so existing checkpoints
-    load unchanged.  ``image_only`` multiplies by just the image-vocab
-    columns — every sampled position is an image position (ref logits mask
-    at dalle_pytorch.py:482-484 forces the text half to -inf there), so the
-    decode path can skip half the matmul and never materialize text logits.
-    ``text_only`` is the mirror image for text positions (the phase-sliced
-    training CE consumes only the text-vocab columns there, ref :489-499).
-    Slicing the kernel before the dot is bit-identical to slicing the full
-    product: each output column is an independent dot-row.
+    The reference keeps a single ``nn.Linear(total_tokens)`` and masks the
+    wrong-phase half to -inf afterwards (dalle_pytorch.py:482-484); here
+    the text-vocab and image-vocab column blocks are separate parameters.
+    Two wins over a single [dim, total] kernel with interior slicing:
 
-    ``bf16_matmul`` runs the matmul with bf16 inputs and f32 accumulation
+    * **Phase fast paths with no slice op**: ``image_only`` multiplies only
+      the image kernel (every sampled position is an image position, so the
+      decode path never computes text logits), ``text_only`` mirrors it.
+      A per-phase matmul is bit-identical to slicing the full product —
+      each output column is an independent dot-row.
+    * **Tensor parallelism**: each phase kernel is tp-sharded on ITS OWN
+      vocab dim, so the phase boundary is a parameter boundary, never an
+      interior slice.  A slice at ``total_text`` (7880 at CUB geometry)
+      inside a single tp-sharded kernel can't align with the equal-width
+      shard boundaries GSPMD requires, forcing a per-step reshard — the
+      round-2 reason ``head_phase_sliced`` auto-disabled under tp.
+
+    Joint-vocab callers get ``concat(text, image)`` — XLA folds a
+    downstream phase slice of that concat back to the operand, so the
+    full-logits path costs the same as before.
+
+    Legacy single-kernel checkpoints are upgraded by
+    ``utils.checkpoint.migrate_head_kernels`` (an exact column split).
+
+    ``bf16_matmul`` runs the matmuls with bf16 inputs and f32 accumulation
     (the MXU's native mode, ~4x the f32 rate); params and the returned
     logits stay f32.
     """
@@ -172,21 +185,25 @@ class PhaseLogits(nn.Module):
     @nn.compact
     def __call__(self, x, image_only: bool = False, text_only: bool = False):
         assert not (image_only and text_only)
-        kernel = self.param("kernel", nn.initializers.lecun_normal(),
-                            (x.shape[-1], self.total), jnp.float32)
-        bias = self.param("bias", nn.initializers.zeros, (self.total,),
-                          jnp.float32)
-        if image_only:
-            kernel = kernel[:, self.total_text:]
-            bias = bias[self.total_text:]
-        elif text_only:
-            kernel = kernel[:, : self.total_text]
-            bias = bias[: self.total_text]
-        if self.bf16_matmul:
-            return jnp.dot(x.astype(jnp.bfloat16),
-                           kernel.astype(jnp.bfloat16),
-                           preferred_element_type=jnp.float32) + bias
-        return x @ kernel + bias
+        num_image = self.total - self.total_text
+        parts = []
+        if not image_only:  # text phase wanted
+            parts.append(("text_kernel", "text_bias", self.total_text))
+        if not text_only:   # image phase wanted
+            parts.append(("image_kernel", "image_bias", num_image))
+        outs = []
+        for kname, bname, width in parts:
+            kernel = self.param(kname, nn.initializers.lecun_normal(),
+                                (x.shape[-1], width), jnp.float32)
+            bias = self.param(bname, nn.initializers.zeros, (width,),
+                              jnp.float32)
+            if self.bf16_matmul:
+                outs.append(jnp.dot(x.astype(jnp.bfloat16),
+                                    kernel.astype(jnp.bfloat16),
+                                    preferred_element_type=jnp.float32) + bias)
+            else:
+                outs.append(x @ kernel + bias)
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=-1)
 
 
 class AxialPositionalEmbedding(nn.Module):

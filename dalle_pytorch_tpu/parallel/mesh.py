@@ -41,7 +41,11 @@ DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
     # with a tile permutation GSPMD can only do by full rematerialization
     # ("Involuntary full rematerialization" per step, wasted ICI bandwidth)
     (r".*(text_emb|image_emb)/embedding$", P("fsdp", "tp")),
-    (r".*to_logits_dense/kernel$", P("fsdp", "tp")),
+    # per-phase head kernels (PhaseLogits): each phase tp-shards its OWN
+    # vocab dim, so the phase boundary is a param boundary — the sliced
+    # head works under tp with no interior-slice resharding
+    (r".*to_logits_dense/(text_kernel|image_kernel)$", P("fsdp", "tp")),
+    (r".*to_logits_dense/(text_bias|image_bias)$", P("tp")),
     # conv kernels (VAE): shard output channels over fsdp only
     (r".*codebook/embedding$", P(None, "fsdp")),
     (r".*/kernel$", P(None, None)),

@@ -191,6 +191,42 @@ def load_sharded_small(path: str | Path):
             restore_args=ocp.checkpoint_utils.construct_restore_args(item)))
 
 
+def migrate_head_kernels(tree, total_text: int):
+    """In-place upgrade of legacy joint-vocab logits heads.
+
+    Checkpoints written before the per-phase head split store
+    ``to_logits_dense`` as ``{kernel: [dim, total], bias: [total]}``; the
+    current layout is per-phase blocks (``text_kernel``/``image_kernel``,
+    ``text_bias``/``image_bias`` — see models/dalle.py::PhaseLogits).  The
+    split at ``total_text`` is an exact column partition of the old joint
+    matmul, so migrated checkpoints are bit-identical.  Safe to call on
+    current checkpoints (no-op).  Returns the tree.
+    """
+    if isinstance(tree, (list, tuple)):
+        # serialized optimizer states nest param-shaped subtrees (the Adam
+        # moments) inside chain lists — migrate those too
+        for v in tree:
+            migrate_head_kernels(v, total_text)
+        return tree
+    if not isinstance(tree, dict):
+        return tree
+    for key, val in tree.items():
+        if key == "to_logits_dense" and isinstance(val, dict) \
+                and "kernel" in val:
+            kern = np.asarray(val.pop("kernel"))
+            bias = np.asarray(val.pop("bias"))
+            assert kern.shape[1] > total_text, (
+                f"legacy head kernel width {kern.shape[1]} does not cover "
+                f"total_text_tokens={total_text}")
+            val["text_kernel"] = kern[:, :total_text]
+            val["image_kernel"] = kern[:, total_text:]
+            val["text_bias"] = bias[:total_text]
+            val["image_bias"] = bias[total_text:]
+        else:
+            migrate_head_kernels(val, total_text)
+    return tree
+
+
 def migrate_qkv_kernels(tree, dim_head: int = 64):
     """In-place upgrade of legacy flat fused-QKV kernels.
 

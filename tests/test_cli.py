@@ -471,6 +471,42 @@ def test_legacy_qkv_checkpoint_migration():
         "kernel"].shape == (d, 3, h, dh)
 
 
+def test_legacy_joint_head_checkpoint_migration():
+    """Pre-split checkpoints (single joint-vocab to_logits_dense kernel)
+    load via migrate_head_kernels: an exact column partition at
+    total_text_tokens, applied through dicts AND the list nesting of
+    serialized optimizer states (Adam moments)."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.utils.checkpoint import migrate_head_kernels
+
+    d, v_text, v_img = 8, 5, 3
+    total = v_text + v_img
+    kern = np.arange(d * total, dtype=np.float32).reshape(d, total)
+    bias = np.arange(total, dtype=np.float32)
+    legacy = {"to_logits_dense": {"kernel": kern.copy(), "bias": bias.copy()},
+              "other": {"kernel": np.ones((d, d), np.float32)}}
+    out = migrate_head_kernels(legacy, v_text)
+    head = out["to_logits_dense"]
+    assert set(head) == {"text_kernel", "image_kernel",
+                         "text_bias", "image_bias"}
+    np.testing.assert_array_equal(head["text_kernel"], kern[:, :v_text])
+    np.testing.assert_array_equal(head["image_kernel"], kern[:, v_text:])
+    np.testing.assert_array_equal(head["text_bias"], bias[:v_text])
+    np.testing.assert_array_equal(head["image_bias"], bias[v_text:])
+    assert out["other"]["kernel"].shape == (d, d)
+    # idempotent on current-format checkpoints
+    again = migrate_head_kernels(out, v_text)
+    assert set(again["to_logits_dense"]) == set(head)
+
+    # optimizer states nest the param tree inside lists (optax chain):
+    opt_like = [{"mu": {"to_logits_dense": {"kernel": kern.copy(),
+                                            "bias": bias.copy()}}},
+                {"count": np.zeros(())}]
+    migrate_head_kernels(opt_like, v_text)
+    assert set(opt_like[0]["mu"]["to_logits_dense"]) == set(head)
+
+
 def test_analyze_logs_cli(tmp_path, capsys):
     """Per-epoch mean/std summary + CSV from `epoch iter loss lr` logs
     (script equivalent of the reference's analysis notebook)."""
@@ -629,6 +665,57 @@ def test_sharded_checkpoint_cross_mesh_resume(trained_vae, tiny_dataset,
 
     ckpt = load_checkpoint(final)
     assert int(ckpt["epoch"]) == 2
+
+
+@pytest.mark.slow
+def test_sharded_resume_from_legacy_joint_head(trained_vae, tiny_dataset,
+                                               tiny_tokenizer_json, tmp_path,
+                                               monkeypatch):
+    """An Orbax checkpoint written before the per-phase head split (joint
+    to_logits_dense/{kernel,bias}) must still resume: weights migrate via
+    the replicated restore+split path, optimizer state restarts fresh with
+    a notice (legacy moment lists no longer align leaf-for-leaf)."""
+    import numpy as np
+
+    monkeypatch.setenv("DALLE_TPU_HPARAMS", json.dumps(DALLE_HPARAMS))
+    monkeypatch.chdir(tmp_path)
+    import train_dalle
+    from dalle_pytorch_tpu.utils.checkpoint import (load_checkpoint_sharded,
+                                                    save_checkpoint_sharded)
+
+    train_dalle.main(["--vae_path", str(trained_vae),
+                      "--image_text_folder", str(tiny_dataset),
+                      "--bpe_path", str(tiny_tokenizer_json),
+                      "--truncate_captions", "--epochs", "1",
+                      "--sharded_checkpoints"])
+    final = tmp_path / "dalle-final.pt.orbax"
+    ckpt = load_checkpoint_sharded(final)
+    head = ckpt["weights"]["to_logits_dense"]
+    # rebuild the pre-split layout: joint kernel/bias column-concat
+    joint = {
+        "kernel": np.concatenate([np.asarray(head["text_kernel"]),
+                                  np.asarray(head["image_kernel"])], axis=1),
+        "bias": np.concatenate([np.asarray(head["text_bias"]),
+                                np.asarray(head["image_bias"])])}
+    ckpt["weights"]["to_logits_dense"] = joint
+    legacy = tmp_path / "legacy.pt.orbax"
+    save_checkpoint_sharded(legacy, ckpt)
+
+    train_dalle.main(["--dalle_path", str(legacy),
+                      "--image_text_folder", str(tiny_dataset),
+                      "--bpe_path", str(tiny_tokenizer_json),
+                      "--truncate_captions", "--epochs", "2",
+                      "--sharded_checkpoints"])
+    resumed = load_checkpoint_sharded(tmp_path / "dalle-final.pt.orbax")
+    new_head = resumed["weights"]["to_logits_dense"]
+    assert set(new_head) == {"text_kernel", "image_kernel",
+                             "text_bias", "image_bias"}
+    # the split is the exact column partition of the legacy joint kernel
+    np.testing.assert_array_equal(
+        np.asarray(new_head["text_kernel"]).shape[1]
+        + np.asarray(new_head["image_kernel"]).shape[1],
+        joint["kernel"].shape[1])
+    assert int(resumed["epoch"]) == 2
 
 
 def test_train_vae_sharded_checkpoints_and_resume(tiny_dataset, tmp_path,

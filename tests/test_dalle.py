@@ -237,9 +237,9 @@ def test_generate_with_top_p(small):
 
 
 def test_full_head_loss_matches_sliced():
-    """head_phase_sliced=False (the tp-mesh execution plan: full head then
-    output slice) must produce the same loss as the default sliced-head
-    path — same math, different matmul partitioning."""
+    """head_phase_sliced=False (the A/B control: both phases computed for
+    every position, then sliced) must produce the same loss as the default
+    sliced-head path — same math, different matmul partitioning."""
     import dataclasses
 
     cfg, dalle, params, text, codes = build()

@@ -234,12 +234,9 @@ def main(argv=None):
     # CLI-selectable on fresh runs AND resumes (not stored in checkpoints)
     sp_plan.update(ff_expert_dispatch=args.ff_expert_dispatch,
                    ff_expert_capacity_factor=args.ff_expert_capacity_factor)
-    if args.mesh_tp > 1:
-        # phase-slicing the head kernel cuts the vocab dim at
-        # total_text_tokens, which doesn't align with tp shard boundaries —
-        # GSPMD would reshard the head every step; full-head + output slice
-        # keeps the kernel evenly tp-sharded (see DALLEConfig)
-        sp_plan.update(head_phase_sliced=False)
+    # (tp meshes keep the phase-sliced head: PhaseLogits stores one kernel
+    # per vocab phase, each tp-sharded on its own vocab dim, so the phase
+    # boundary is a param boundary — no interior-slice resharding)
     pp_mode = args.pipeline_stages > 1
 
     tokenizer = select_tokenizer(args.bpe_path, chinese=args.chinese)
@@ -346,12 +343,15 @@ def main(argv=None):
             lambda r: dalle_dense.init(r, dummy_text, dummy_codes)['params']
         )(init_rng)
         if resume_ckpt is not None:
-            from dalle_pytorch_tpu.utils.checkpoint import migrate_qkv_kernels
+            from dalle_pytorch_tpu.utils.checkpoint import (
+                migrate_head_kernels, migrate_qkv_kernels)
 
             params = jax.tree.map(
                 jnp.asarray,
-                migrate_qkv_kernels(resume_ckpt['weights'],
-                                    dim_head=dalle_cfg.dim_head))
+                migrate_head_kernels(
+                    migrate_qkv_kernels(resume_ckpt['weights'],
+                                        dim_head=dalle_cfg.dim_head),
+                    dalle_cfg.total_text_tokens))
         params = part.shard_params(params)
     is_custom_vae = isinstance(vae, DiscreteVAE)
     if vae_weights is not None:
@@ -424,11 +424,38 @@ def main(argv=None):
         # ShapeDtypeStruct carrying THIS run's sharding (params/opt/vae
         # templates above), then restore — every host reads only its shards,
         # directly onto the current mesh, whatever topology wrote the ckpt
-        from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint_sharded
+        from dalle_pytorch_tpu.utils.checkpoint import (
+            load_checkpoint_sharded, migrate_head_kernels)
 
         target = dict(resume_ckpt)
         target['weights'] = params  # already ShapeDtypeStructs w/ shardings
-        if 'opt_state' in resume_ckpt:
+        # checkpoints written before the per-phase head split store a joint
+        # to_logits_dense/{kernel,bias}: restore that pair replicated, then
+        # split it onto this run's per-phase shardings after the restore
+        legacy_head = 'kernel' in resume_ckpt.get('weights', {}).get(
+            'to_logits_dense', {})
+        if legacy_head:
+            new_head_tmpl = params['to_logits_dense']  # keep: shardings
+            target['weights'] = dict(params)
+            # int() casts: restored hparams carry 0-d numpy scalars, which
+            # sharding.shard_shape cannot hash inside a shape tuple
+            target['weights']['to_logits_dense'] = {
+                'kernel': jax.ShapeDtypeStruct(
+                    (int(dalle_cfg.dim), int(dalle_cfg.total_tokens)),
+                    jnp.float32, sharding=part.repl_sharding),
+                'bias': jax.ShapeDtypeStruct(
+                    (int(dalle_cfg.total_tokens),), jnp.float32,
+                    sharding=part.repl_sharding)}
+        restore_opt = 'opt_state' in resume_ckpt and not legacy_head
+        if 'opt_state' in resume_ckpt and legacy_head:
+            # the legacy moment lists no longer align leaf-for-leaf with the
+            # split-head template (2 head leaves became 4): leave their
+            # `...` placeholders in the target so orbax skips reading them,
+            # and restart the optimizer rather than zip-truncate silently
+            if distr_backend.is_root_worker():
+                print('legacy joint-head checkpoint: weights migrated to the '
+                      'per-phase head; optimizer state restarts fresh')
+        elif restore_opt:
             target['opt_state'] = [
                 sds if saved is ... else saved
                 for sds, saved in zip(part.opt_state_templates(opt_state),
@@ -444,7 +471,17 @@ def main(argv=None):
             target['vae_weights'] = vae_params  # ShapeDtypeStruct templates
         restored = load_checkpoint_sharded(resume_sharded, target=target)
         params = restored['weights']
-        if 'opt_state' in restored:
+        if legacy_head:
+            head = migrate_head_kernels(
+                {'to_logits_dense': {
+                    k: np.asarray(v)
+                    for k, v in params['to_logits_dense'].items()}},
+                dalle_cfg.total_text_tokens)['to_logits_dense']
+            params = dict(params)
+            params['to_logits_dense'] = {
+                k: jax.device_put(jnp.asarray(head[k]), tmpl.sharding)
+                for k, tmpl in new_head_tmpl.items()}
+        if restore_opt and 'opt_state' in restored:
             # big arrays restored onto their templates' shardings pass
             # through untouched; 0-d leaves (optax count) restored by value
             # get cast back to the template dtype
@@ -472,6 +509,13 @@ def main(argv=None):
             print('--pipeline_stages: checkpointed optimizer state targets '
                   'the dense layout; continuing with fresh optimizer state')
     elif resume_ckpt is not None and 'opt_state' in resume_ckpt:
+        from dalle_pytorch_tpu.utils.checkpoint import migrate_head_kernels
+
+        # legacy joint-head Adam moments split the same way the params do
+        # (leaf COUNT changes, so this must happen before the unflatten)
+        migrate_head_kernels(resume_ckpt['opt_state'],
+                             dalle_cfg.total_text_tokens)
+
         def _fit_leaf(tmpl, v):
             if not hasattr(tmpl, 'dtype'):
                 return v
