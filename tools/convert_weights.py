@@ -311,7 +311,18 @@ def convert_clip_state_dict(sd: dict, vision_layers: int = 12,
 def _torch_load(path):
     import torch
 
-    obj = torch.load(path, map_location="cpu", weights_only=False)
+    try:
+        obj = torch.load(path, map_location="cpu", weights_only=False)
+    except RuntimeError as plain_err:
+        # the released CLIP ViT-B-32.pt is a TorchScript archive, which
+        # plain torch.load rejects (ref genrank.py:22 loads it via
+        # clip.load); jit.load gives the same state_dict.  Chain the
+        # original error if jit.load ALSO fails — a truncated download
+        # raises here too, and the plain-load message is the diagnosis.
+        try:
+            obj = torch.jit.load(path, map_location="cpu")
+        except Exception:
+            raise plain_err from None
     if hasattr(obj, "state_dict"):
         obj = obj.state_dict()
     if isinstance(obj, dict) and "state_dict" in obj:

@@ -1,0 +1,81 @@
+#!/bin/sh
+# One-command pretrained-weights path: download the released checkpoints the
+# reference uses at runtime, convert them to this framework's msgpack params,
+# and smoke-decode one output per model.
+#
+#   tools/fetch_and_convert.sh [--dry-run] [DIR]
+#
+# DIR (default ./pretrained) receives raw/ (downloads), the converted
+# *.msgpack, and smoke/ (one decoded PNG per VAE).  Idempotent: existing
+# files are kept, so a flaky download resumes where it left off.
+#
+# --dry-run replaces the downloads with synthesized full-size checkpoints in
+# the released formats (tools/synth_released.py) — the whole convert+smoke
+# pipeline runs for real, so this is executable (and CI-tested) today in the
+# egress-less environment, and the real path is one flag away the moment
+# egress exists.
+#
+# Sources (ref /root/reference/dalle_pytorch/vae.py:29-33, genrank.py:20-22):
+#   OpenAI dVAE     https://cdn.openai.com/dall-e/{encoder,decoder}.pkl
+#   Taming VQGAN    https://heibox.uni-heidelberg.de/f/140747ba53464f49b476/?dl=1
+#   CLIP ViT-B/32   https://openaipublic.azureedge.net/clip/models/...ViT-B-32.pt
+set -eu
+
+DRY=0
+DIR=""
+for arg in "$@"; do
+  case "$arg" in
+    --dry-run) DRY=1 ;;
+    --*) echo "unknown flag: $arg (usage: $0 [--dry-run] [DIR])" >&2
+         exit 2 ;;
+    *) [ -n "$DIR" ] && { echo "extra argument: $arg" >&2; exit 2; }
+       DIR=$arg ;;
+  esac
+done
+DIR=${DIR:-pretrained}
+RAW="$DIR/raw"
+mkdir -p "$RAW"
+HERE=$(dirname "$0")
+
+fetch() { # fetch <url> <dest>
+  [ -f "$2" ] && { echo "have $2"; return 0; }
+  echo "fetching $1 -> $2"
+  if command -v curl >/dev/null 2>&1; then
+    curl -L --fail --retry 3 -o "$2.part" "$1"
+  else
+    wget -O "$2.part" "$1"
+  fi
+  mv "$2.part" "$2"
+}
+
+if [ "$DRY" = 1 ]; then
+  # .synth_done marks a COMPLETE synth: torch.save is not atomic, so file
+  # existence alone could wedge the skip check on an interrupted run
+  if [ -f "$RAW/.synth_done" ]; then
+    echo "have synthesized checkpoints"
+  else
+    rm -f "$RAW/.synth_done"
+    python "$HERE/synth_released.py" --out "$RAW"
+    touch "$RAW/.synth_done"
+  fi
+else
+  fetch "https://cdn.openai.com/dall-e/encoder.pkl" "$RAW/encoder.pkl"
+  fetch "https://cdn.openai.com/dall-e/decoder.pkl" "$RAW/decoder.pkl"
+  fetch "https://heibox.uni-heidelberg.de/f/140747ba53464f49b476/?dl=1" \
+        "$RAW/vqgan.1024.model.ckpt"
+  fetch "https://openaipublic.azureedge.net/clip/models/40d365715913c9da98579312b702a82c18be219cc2a73407c4526f58eba950af/ViT-B-32.pt" \
+        "$RAW/ViT-B-32.pt"
+fi
+
+[ -f "$DIR/openai_jax.msgpack" ] || python "$HERE/convert_weights.py" openai \
+  --encoder "$RAW/encoder.pkl" --decoder "$RAW/decoder.pkl" \
+  --out "$DIR/openai_jax.msgpack"
+[ -f "$DIR/vqgan_jax.msgpack" ] || python "$HERE/convert_weights.py" vqgan \
+  --ckpt "$RAW/vqgan.1024.model.ckpt" --out "$DIR/vqgan_jax.msgpack"
+[ -f "$DIR/clip_jax.msgpack" ] || python "$HERE/convert_weights.py" clip \
+  --ckpt "$RAW/ViT-B-32.pt" --out "$DIR/clip_jax.msgpack"
+
+python "$HERE/smoke_decode.py" --dir "$DIR"
+
+echo "done: $DIR/{openai,vqgan,clip}_jax.msgpack ready"
+echo "use: generate.py/genrank.py pick them up via --taming / --clip_path"

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Synthesize the released pretrained checkpoints in their shipped formats.
+
+This environment has no network egress, so `tools/fetch_and_convert.sh
+--dry-run` uses this to stand in for the downloads: full-size torch twins
+of the three released models (taming VQGAN f=16/1024, OpenAI dVAE, CLIP
+ViT-B/32) are built at the exact published geometries, given sane random
+weights, and written in the same on-disk formats the real fetches produce:
+
+* ``vqgan.1024.model.ckpt`` — ``torch.save({'state_dict': ...})`` (taming's
+  lightning checkpoint layout, ref vae.py:98-170 consumes it)
+* ``encoder.pkl`` / ``decoder.pkl`` — torch-saved modules (the DALL-E
+  package's blobs at cdn.openai.com are torch-saved modules too,
+  ref vae.py:29-33)
+* ``ViT-B-32.pt`` — a torch-saved module (the real file is a TorchScript
+  archive; ``convert_weights._torch_load`` accepts both)
+
+The twin graphs live next to the converter's unit tests
+(tests/test_weight_conversion.py) — they are the same modules the
+full-size converter validation drives, so a dry run through this file
+exercises exactly the pipeline a real download would.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+
+def _scaled_(sd):
+    """Match tests/test_weight_conversion_fullsize.py::_scaled: norm scales
+    ~1, biases small, kernels fan-in scaled — activations stay O(1) through
+    20+-layer graphs so smoke decodes produce finite, plausible outputs."""
+    rng = np.random.default_rng(0)
+    out = {}
+    for k, v in sd.items():
+        v = v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
+        if v.ndim <= 1 and k.endswith(".weight"):
+            out[k] = (1.0 + 0.01 * rng.normal(size=v.shape)).astype(np.float32)
+        elif v.ndim <= 1:
+            out[k] = (0.01 * rng.normal(size=v.shape)).astype(np.float32)
+        else:
+            fan_in = int(np.prod(v.shape[1:]))
+            out[k] = (rng.normal(size=v.shape) / np.sqrt(fan_in)).astype(
+                np.float32)
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", required=True, help="directory for the "
+                        "synthesized checkpoint files")
+    args = parser.parse_args(argv)
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+
+    from unittest import mock
+
+    import torch
+
+    import test_weight_conversion as twc
+
+    # pin the shared VQGAN twin to the released vqgan_imagenet_f16_1024
+    # geometry (the twins default to small unit-test sizes; the module
+    # constants are read at construction AND call time, so the patch wraps
+    # everything below)
+    patch = mock.patch.multiple(twc, CH=128, CH_MULT=(1, 1, 2, 2, 4),
+                                NRES=2, Z=256)
+    patch.start()
+
+    def load_scaled(module):
+        sd = _scaled_(module.state_dict())
+        # as_tensor: 0-d entries (CLIP's logit_scale) come back as numpy
+        # scalars, which from_numpy rejects
+        module.load_state_dict({k: torch.as_tensor(v)
+                                for k, v in sd.items()})
+        return module
+
+    # taming VQGAN f=16 / 1024 codes (vqgan_imagenet_f16_1024 ddconfig)
+    t_enc = load_scaled(twc.TVQEncoder(attn_levels=(4,)))
+    t_dec = load_scaled(twc.TVQDecoder(attn_levels=(4,)))
+    sd = {f"encoder.{k}": v for k, v in t_enc.state_dict().items()}
+    sd.update({f"decoder.{k}": v for k, v in t_dec.state_dict().items()})
+    extra = _scaled_({
+        "quantize.embedding.weight": np.zeros((1024, 256), np.float32),
+        "quant_conv.weight": np.zeros((256, 256, 1, 1), np.float32),
+        "quant_conv.bias": np.zeros(256, np.float32),
+        "post_quant_conv.weight": np.zeros((256, 256, 1, 1), np.float32),
+        "post_quant_conv.bias": np.zeros(256, np.float32)})
+    sd.update({k: torch.from_numpy(v) for k, v in extra.items()})
+    torch.save({"state_dict": sd}, out / "vqgan.1024.model.ckpt")
+    print(f"wrote {out / 'vqgan.1024.model.ckpt'}")
+
+    # OpenAI dVAE (n_hid 256, 2 blocks/group, vocab 8192).  The twins are
+    # test-local classes, so the modules themselves don't pickle — their
+    # state dicts do, and _torch_load normalizes modules, {'state_dict': .}
+    # and plain state dicts to the same mapping.
+    torch.save(load_scaled(twc.make_oai_encoder_twin(
+        hid=256, bpg=2, vocab=8192)).state_dict(), out / "encoder.pkl")
+    torch.save(load_scaled(twc.make_oai_decoder_twin(
+        hid=256, bpg=2, vocab=8192)).state_dict(), out / "decoder.pkl")
+    print(f"wrote {out / 'encoder.pkl'}, {out / 'decoder.pkl'}")
+
+    # CLIP ViT-B/32
+    clip = load_scaled(twc.make_clip_twin(
+        W=768, HEADS=12, LAYERS=12, PATCH=32, IMG=224, VOCAB=49408, CTX=77,
+        EMB=512, TEXT_W=512, TEXT_HEADS=8))
+    torch.save(clip.state_dict(), out / "ViT-B-32.pt")
+    print(f"wrote {out / 'ViT-B-32.pt'}")
+
+
+if __name__ == "__main__":
+    main()
