@@ -351,6 +351,87 @@ def test_genrank_cli_with_clip_vit(trained_dalle, tiny_tokenizer_json,
     assert float(std) >= 0.0 and mean not in ("nan", "0.0")
 
 
+@pytest.mark.slow
+def test_genrank_ranking_order_with_trained_clip(tiny_tokenizer_json,
+                                                 tmp_path, monkeypatch):
+    """genrank's ranking math must be discriminative, not just run: a tiny
+    CLIP trained in-test to separate 'red' from 'blue' solid images, driven
+    through the FULL CLI (save -> JPEG re-read -> preprocess -> rank ->
+    results.txt), must score every caption-matching image above every
+    mismatched one (VERDICT r2 weak #7; ref harness genrank.py:68-77,
+    :128-135).  Generation is stubbed with constructed images — ranking
+    can't be asserted against a sampler's randomness; the generate path has
+    its own tests."""
+    import jax
+    import jax.numpy as jnp
+
+    import genrank
+    from dalle_pytorch_tpu.data.tokenizer import HugTokenizer
+    from dalle_pytorch_tpu.models.clip import CLIP, CLIPConfig
+    from dalle_pytorch_tpu.training import (make_clip_train_step,
+                                            make_optimizer)
+    from dalle_pytorch_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = CLIPConfig(
+        dim_text=32, dim_image=32, dim_latent=16, num_text_tokens=64,
+        text_enc_depth=1, text_seq_len=8, text_heads=2, num_visual_tokens=64,
+        visual_enc_depth=1, visual_heads=2, visual_image_size=16,
+        visual_patch_size=8)
+    tok = HugTokenizer(tiny_tokenizer_json)
+    captions = tok.tokenize(["red", "blue"], cfg.text_seq_len)
+    solid = np.zeros((2, 16, 16, 3), np.float32)
+    solid[0, ..., 0] = 0.9  # red
+    solid[1, ..., 2] = 0.9  # blue
+
+    def preprocessed(images01):
+        """The exact normalization genrank applies before scoring."""
+        return (images01 - genrank._CLIP_MEAN) / genrank._CLIP_STD
+
+    model = CLIP(cfg)
+    rng = np.random.default_rng(0)
+    text = jnp.asarray(captions, jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), text,
+                        jnp.asarray(preprocessed(solid)))["params"]
+    tx = make_optimizer(3e-3)
+    opt_state = jax.jit(tx.init)(params)
+    step = make_clip_train_step(model, tx, donate=False)
+    for _ in range(60):
+        noisy = solid + rng.normal(0, 0.03, solid.shape).astype(np.float32)
+        params, opt_state, loss = step(
+            params, opt_state, text, jnp.asarray(preprocessed(noisy)), None)
+    assert float(loss) < np.log(2) * 0.5, "tiny CLIP failed to separate"
+
+    clip_path = tmp_path / "clip_trained.pt"
+    save_checkpoint(clip_path, {"hparams": cfg.to_dict(),
+                                "weights": jax.device_get(params)})
+
+    # 3 caption-matching (red) + 3 mismatched (blue) candidates, shuffled
+    # order [red, blue, red, blue, red, blue]
+    cand = np.zeros((6, 32, 32, 3), np.float32)
+    for i in range(6):
+        base = solid[i % 2]
+        cand[i] = np.clip(
+            np.repeat(np.repeat(base, 2, 0), 2, 1)
+            + rng.normal(0, 0.03, (32, 32, 3)), 0, 1)
+
+    monkeypatch.setattr(
+        genrank, "generate_images",
+        lambda *a, **k: (cand, HugTokenizer(tiny_tokenizer_json)))
+
+    out = tmp_path / "rank_out"
+    genrank.main(["--dalle_path", "dalle-fake.pt", "--text", "red",
+                  "--num_images", "6", "--bpe_path",
+                  str(tiny_tokenizer_json), "--clip_path", str(clip_path),
+                  "--out_path", str(out)])
+
+    logits = np.load(out / "Bdalle-fake.npy")
+    red_scores, blue_scores = logits[0::2], logits[1::2]
+    # every matching image outranks every mismatched one
+    assert red_scores.min() > blue_scores.max(), logits
+    line = (out / "results.txt").read_text().strip().split(" ")
+    assert len(line) == 3 and np.isfinite(float(line[1]))
+
+
 def test_genrank_cli(trained_dalle, tiny_tokenizer_json, workdir):
     cwd = os.getcwd()
     os.chdir(workdir)
