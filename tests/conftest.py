@@ -7,13 +7,16 @@ bench.py.  Must run before any backend is initialized; the axon TPU plugin
 registered in sitecustomize is overridden via jax.config.
 
 Fast profile: long-running tests (end-to-end training, multiprocess
-integration, full-size weight conversion, ...) carry ``@pytest.mark.slow``
-and are skipped unless ``--runslow`` is passed — so the default
-``python -m pytest tests/ -x -q`` is the always-green quick contract and
-``--runslow`` is the full nightly sweep (see .github/workflows/tests.yml).
-Measured 2026-07-31 on a 1-core dev box: ~8 min warm-cache (~2.4x faster
-than cold thanks to the persistent XLA compile cache below); a multi-core
-CI runner compiles in parallel and lands well under that.
+integration, full-size weight conversion, parametrized-sweep duplicates
+whose contract keeps one representative in the fast tier, ...) carry
+``@pytest.mark.slow`` and are skipped unless ``--runslow`` is passed — so
+the default ``python -m pytest tests/ -x -q`` is the always-green quick
+contract and ``--runslow`` is the full nightly sweep (see
+.github/workflows/tests.yml).  Measured 2026-07-31 on a 1-core dev box:
+~5.2 min warm-cache (was ~8.8 min before the r3 trim: sweep duplicates
+demoted to slow, op-by-op grad dispatches jitted — the compile is ~3x
+cheaper than unjitted dispatch and the cache makes reruns free); a
+multi-core CI runner compiles in parallel and lands well under that.
 """
 import os
 

@@ -175,15 +175,19 @@ def test_onehot_embed_equivalent():
 
     cfg, dalle, params, text, codes = build()
     dalle_oh = DALLE(dataclasses.replace(cfg, onehot_embed=True))
-    a = np.asarray(dalle.apply(params, text, codes))
-    b = np.asarray(dalle_oh.apply(params, text, codes))
+    # jitted: the unjitted op-by-op dispatch of a full-DALLE grad costs 3x
+    # the compile (measured on the 1-core box); the cache makes reruns free
+    a = np.asarray(jax.jit(dalle.apply)(params, text, codes))
+    b = np.asarray(jax.jit(dalle_oh.apply)(params, text, codes))
     np.testing.assert_array_equal(a, b)
 
-    la = float(dalle.apply(params, text, codes, return_loss=True))
-    lb = float(dalle_oh.apply(params, text, codes, return_loss=True))
+    la = float(jax.jit(lambda p: dalle.apply(p, text, codes,
+                                             return_loss=True))(params))
+    lb = float(jax.jit(lambda p: dalle_oh.apply(p, text, codes,
+                                                return_loss=True))(params))
     assert la == lb
-    g = jax.grad(lambda p: dalle_oh.apply(p, text, codes, return_loss=True))(
-        params)
+    g = jax.jit(jax.grad(
+        lambda p: dalle_oh.apply(p, text, codes, return_loss=True)))(params)
     total = jax.tree.reduce(lambda a, x: a + float(jnp.abs(x).sum()), g, 0.0)
     assert np.isfinite(total) and total > 0
 

@@ -62,7 +62,8 @@ def test_stack_stage_params_roundtrip():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-@pytest.mark.parametrize("num_microbatches", [2, 4])
+@pytest.mark.parametrize("num_microbatches", [
+    2, pytest.param(4, marks=pytest.mark.slow)])
 def test_pipeline_matches_local_pp2(mesh_pp2, num_microbatches):
     tf, params, x = setup(jax.random.PRNGKey(1))
     ref = tf.apply({"params": params}, x)
@@ -74,6 +75,7 @@ def test_pipeline_matches_local_pp2(mesh_pp2, num_microbatches):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # pp2 covers the contract in the fast tier
 def test_pipeline_matches_local_pp4(mesh_pp4):
     tf = make_tf(depth=4, attn_types=("full",))
     x = jax.random.normal(jax.random.PRNGKey(2), (4, N, DIM))

@@ -38,7 +38,8 @@ def rand_qkv(key):
     return tuple(jax.random.normal(k, (B, H, N, DH)) for k in ks)
 
 
-@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("causal", [
+    True, pytest.param(False, marks=pytest.mark.slow)])
 def test_ring_matches_dense(mesh8, causal):
     q, k, v = rand_qkv(jax.random.PRNGKey(0))
     out = ring_attention_sharded(q, k, v, mesh8, causal=causal)
@@ -47,8 +48,15 @@ def test_ring_matches_dense(mesh8, causal):
                                rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("variant", ["full", "axial_row", "axial_col",
-                                     "conv_like", "sparse"])
+# one representative pattern stays in the fast tier ("sparse": the
+# most irregular predicate); the rest of the sweep is nightly-only
+@pytest.mark.parametrize("variant", [
+    pytest.param("full", marks=pytest.mark.slow),
+    pytest.param("axial_row", marks=pytest.mark.slow),
+    pytest.param("axial_col", marks=pytest.mark.slow),
+    pytest.param("conv_like", marks=pytest.mark.slow),
+    "sparse",
+])
 def test_ring_with_patterns(mesh8, variant):
     pattern = AttnPattern(variant=variant, seq_len=N - 1, text_len=TEXT,
                           fmap=FMAP)

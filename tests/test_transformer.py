@@ -36,8 +36,9 @@ def test_remat_matches_plain():
     out_b = tf_b.apply(params, x)
     np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), atol=1e-6)
 
-    ga = jax.grad(lambda p: (tf_a.apply(p, x) ** 2).sum())(params)
-    gb = jax.grad(lambda p: (tf_b.apply(p, x) ** 2).sum())(params)
+    # jitted grads: op-by-op dispatch costs ~3x the compile on the dev box
+    ga = jax.jit(jax.grad(lambda p: (tf_a.apply(p, x) ** 2).sum()))(params)
+    gb = jax.jit(jax.grad(lambda p: (tf_b.apply(p, x) ** 2).sum()))(params)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), atol=1e-5), ga, gb)
 
