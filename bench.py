@@ -170,7 +170,13 @@ def make_train_measure(steps: int = STEPS, batch: int = 16, **overrides):
 
 
 def run(use_pallas: bool = False, steps: int = STEPS):
-    measure, cfg, batch = make_train_measure(steps, use_pallas=use_pallas)
+    # BENCH_BATCH: record a candidate headline at a different batch without
+    # editing code mid-window (the babysitter's A/B-then-measure flow).
+    # The JSON meta carries the batch either way, and images/sec stays the
+    # per-image basis across batch sizes.
+    batch = int(os.environ.get("BENCH_BATCH", 16))
+    measure, cfg, batch = make_train_measure(steps, batch=batch,
+                                             use_pallas=use_pallas)
     images_per_sec, dt = measure()
     return images_per_sec, dt, cfg, batch
 
