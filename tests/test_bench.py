@@ -442,7 +442,13 @@ def test_history_recorded_on_chip_not_on_cpu(monkeypatch, tmp_path, capsys):
     monkeypatch.setattr(bench.jax, "devices", lambda: [fake])
     bench.main()
     capsys.readouterr()
-    (line,) = hist.read_text().splitlines()
-    rec = json.loads(line)
+    lines = [json.loads(l) for l in hist.read_text().splitlines()]
+    # headline + one gen record per batch (8, 64)
+    assert [r.get("metric") for r in lines] == [
+        "dalle_cub200_train_throughput",
+        "dalle_cub200_gen_throughput", "dalle_cub200_gen_throughput"]
+    rec = lines[0]
     assert rec["value"] == 42.5 and rec["device"] == "TPU v5 lite"
     assert rec["mfu"] >= 0 and rec["tflops"] >= 0 and "ts" in rec
+    assert [r["meta"]["batch"] for r in lines[1:]] == [8, 64]
+    assert all(r["unit"] == "image_tokens/sec" for r in lines[1:])
