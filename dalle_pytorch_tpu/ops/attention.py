@@ -194,6 +194,60 @@ def pattern_mask_row(pattern: AttnPattern, index, n_k: int,
     return _allowed(pattern, index, j, jnp, layout=layout)
 
 
+def decode_key_positions(
+        pattern: AttnPattern, index
+) -> Optional[Tuple[jax.Array, jax.Array]]:
+    """Candidate key positions for ONE decode query at (traced) `index`.
+
+    Decode queries are always image positions (only image tokens are
+    sampled), and for the axial/conv patterns their reachable key set is a
+    small, position-computable subset of the cache: all text plus the
+    query's raster row / column / causal neighborhood rows.  Returning that
+    superset (exactness is restored by ``_allowed`` over the returned
+    positions) lets the decode step GATHER ~10% of the KV cache instead of
+    streaming all of it through the masked dots — the decode loop is HBM-
+    bandwidth-bound, so cache traffic is the throughput (the training path
+    is unaffected; dense-masked attention there is MXU-optimal).
+
+    Returns traced ``(positions [m] int32, valid [m] bool)`` with m static,
+    or None for variants whose reachable set isn't smaller (full) or isn't
+    position-local (sparse's random blocks).  ``valid`` is essential, not
+    decorative: an out-of-image candidate (a conv row above the raster top)
+    clipped for the gather would ALIAS onto a text position that the text
+    segment already carries — an aliased duplicate passes ``_allowed`` and
+    double-counts that key in the softmax, so image candidates are valid
+    only when their raster row genuinely exists.
+    """
+    T, W = pattern.text_len, pattern.fmap
+    v = pattern.variant
+    ii = index - T
+    ri, ci = ii // W, ii % W
+    if v == "axial_row":
+        img = T + ri * W + jnp.arange(W)
+        # ii >= 0: a text-region query (legal through the public decode_step
+        # API) has ri < 0 and its aliased "row" would double-count text keys
+        img_valid = jnp.broadcast_to(ii >= 0, (W,))
+    elif v == "axial_col":
+        img = T + ci + jnp.arange(W) * W
+        img_valid = jnp.broadcast_to(ii >= 0, (W,))
+    elif v == "conv_like":
+        pad = ((pattern.kernel - 1) * pattern.dilation + 1) // 2
+        # causality kills every row below the query's, so candidates are
+        # the query row and the window rows above it, at the dilation
+        # stride; each row is taken whole (W keys) and the window's column
+        # extent is enforced by the predicate
+        n_rows = pad // pattern.dilation + 1
+        rows = ri - pattern.dilation * jnp.arange(n_rows)
+        img = (T + rows[:, None] * W + jnp.arange(W)[None, :]).reshape(-1)
+        img_valid = jnp.broadcast_to(
+            ((rows >= 0) & (rows < W))[:, None], (n_rows, W)).reshape(-1)
+    else:  # full: everything is reachable; sparse: random blocks aren't local
+        return None
+    positions = jnp.concatenate([jnp.arange(T), img]).astype(jnp.int32)
+    valid = jnp.concatenate([jnp.ones((T,), bool), img_valid])
+    return positions, valid
+
+
 def _scope_key_pad(pattern: AttnPattern, key_mask, n_k: int):
     """Per-variant scope of a [b, m] key padding mask (True = keep) -> [b,
     n_k] bool.  Parity: the full variant applies it to every key
@@ -332,6 +386,32 @@ class MultiHeadAttention(nn.Module):
                                                (0, 0, index, 0))
         n_k = cache_k.shape[2]
         scale = self.dim_head ** -0.5
+        sliced = decode_key_positions(self.pattern, index)
+        if sliced is not None:
+            # sliced-cache decode: gather only the reachable keys (text +
+            # row/col/neighborhood) — the decode loop is HBM-bound on cache
+            # reads, and the axial/conv patterns reach ~10% of the cache.
+            # Same math as the dense path: softmax over the masked subset
+            # equals softmax over the masked full row (excluded entries
+            # contribute exp(-inf) = 0).
+            positions, valid = sliced
+            valid = valid & (positions >= 0) & (positions < n_k)
+            safe = jnp.clip(positions, 0, n_k - 1)
+            k_sub = jnp.take(cache_k, safe, axis=2)  # [b, h, m, dh]
+            v_sub = jnp.take(cache_v, safe, axis=2)
+            dots = jnp.einsum("bhid,bhjd->bhij", q * scale, k_sub,
+                              preferred_element_type=jnp.float32)
+            row = (_allowed(self.pattern, index, positions, jnp)
+                   & valid)[None, None, None, :]
+            if mask is not None:
+                pad = _scope_key_pad(self.pattern, mask, n_k)
+                row = row & jnp.take(pad, safe, axis=1)[:, None, None, :]
+            dots = jnp.where(row, dots, max_neg_value(dots.dtype))
+            attn = jax.nn.softmax(dots, axis=-1).astype(x.dtype)
+            out = jnp.einsum("bhij,bhjd->bhid", attn, v_sub.astype(x.dtype))
+            out = out.transpose(0, 2, 1, 3).reshape(
+                b, 1, self.heads * self.dim_head)
+            return self.to_out(out), cache_k, cache_v
         dots = jnp.einsum("bhid,bhjd->bhij", q * scale, cache_k,
                           preferred_element_type=jnp.float32)
         layout = self.pattern.block_layout()

@@ -117,29 +117,38 @@ def test_decode_row_matches_dense_mask():
 
 
 def test_attention_forward_decode_equivalence():
-    """Full-sequence forward vs token-by-token decode with KV cache."""
+    """Full-sequence forward vs token-by-token decode with KV cache — for
+    every variant, from a TEXT-region start (decode_step is a public
+    position-agnostic API: aliased negative-row candidates must not
+    double-count text keys in the sliced-cache path), and both without and
+    with a partial key-padding mask (the sliced branch gathers its scoped
+    pad mask and could drift from the dense path unobserved otherwise)."""
     rng = jax.random.PRNGKey(0)
-    for variant in ("full", "axial_row", "conv_like", "sparse"):
-        pattern = make_pattern(variant)
-        attn = MultiHeadAttention(pattern=pattern, dim=32, heads=2, dim_head=8)
-        x = jax.random.normal(rng, (2, SEQ_LEN, 32))
-        params = attn.init(rng, x)
-        out_full, (k, v) = attn.apply(params, x, return_kv=True)
+    key_mask = jnp.asarray(
+        np.arange(SEQ_LEN)[None, :] < np.asarray([[3], [SEQ_LEN]]))
+    for variant in ("full", "axial_row", "axial_col", "conv_like", "sparse"):
+        for mask in (None, key_mask):
+            pattern = make_pattern(variant)
+            attn = MultiHeadAttention(pattern=pattern, dim=32, heads=2,
+                                      dim_head=8)
+            x = jax.random.normal(rng, (2, SEQ_LEN, 32))
+            params = attn.init(rng, x)
+            out_full, (k, v) = attn.apply(params, x, mask, return_kv=True)
 
-        # decode positions TEXT_LEN.. using caches filled by the "prefill"
-        ck = jnp.zeros((2, 2, SEQ_LEN, 8))
-        cv = jnp.zeros((2, 2, SEQ_LEN, 8))
-        # fill cache with real k/v for all positions < start
-        start = TEXT_LEN
-        ck = ck.at[:, :, :start].set(k[:, :, :start])
-        cv = cv.at[:, :, :start].set(v[:, :, :start])
-        for i in range(start, SEQ_LEN):
-            out_i, ck, cv = attn.apply(
-                params, x[:, i : i + 1], ck, cv, jnp.asarray(i),
-                method=MultiHeadAttention.decode_step)
-            np.testing.assert_allclose(
-                np.asarray(out_i[:, 0]), np.asarray(out_full[:, i]),
-                rtol=2e-4, atol=2e-5, err_msg=f"{variant} pos {i}")
+            # decode from INSIDE the text region using prefilled caches
+            ck = jnp.zeros((2, 2, SEQ_LEN, 8))
+            cv = jnp.zeros((2, 2, SEQ_LEN, 8))
+            start = 2
+            ck = ck.at[:, :, :start].set(k[:, :, :start])
+            cv = cv.at[:, :, :start].set(v[:, :, :start])
+            for i in range(start, SEQ_LEN):
+                out_i, ck, cv = attn.apply(
+                    params, x[:, i : i + 1], ck, cv, jnp.asarray(i),
+                    mask=mask, method=MultiHeadAttention.decode_step)
+                np.testing.assert_allclose(
+                    np.asarray(out_i[:, 0]), np.asarray(out_full[:, i]),
+                    rtol=2e-4, atol=2e-5,
+                    err_msg=f"{variant} pos {i} mask={mask is not None}")
 
 
 def test_key_pad_mask_full_variant():
