@@ -316,6 +316,23 @@ def test_perf_ab_tool(monkeypatch, capsys):
     assert "tok/s" in out
     assert seen_gen_batches == [8, 64]
 
+    # gen-dense compiles the sampler with the sliced-KV decode disabled,
+    # and MUST restore the real decode_key_positions afterwards
+    from dalle_pytorch_tpu.ops import attention as attn_mod
+
+    real_dkp = attn_mod.decode_key_positions
+    patched_during_build = []
+
+    def spying_mgm2(batch=8):
+        patched_during_build.append(
+            attn_mod.decode_key_positions(None, None) is None)
+        return real_mgm(batch=batch)
+
+    monkeypatch.setattr(bench, "make_gen_measure", spying_mgm2)
+    assert perf_ab.main(["gen-dense", "--reps", "1"]) == 0
+    assert patched_during_build == [True]
+    assert attn_mod.decode_key_positions is real_dkp
+
 
 def test_perf_ab_rejects_bad_args(monkeypatch, capsys):
     from pathlib import Path

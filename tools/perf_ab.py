@@ -63,8 +63,11 @@ VARIANTS = {
     "fmap64-pallas": dict(batch=4, image_fmap_size=64, use_pallas=True),
 }
 
-# pseudo-variants measuring other bench loops (not train-step configs)
-EXTRAS = ("gen", "gen64", "vae")
+# pseudo-variants measuring other bench loops (not train-step configs).
+# gen-dense: the sampler with the sliced-KV decode disabled (dense cache
+# reads every step) — the A/B control for ops/attention.py's
+# decode_key_positions gather.
+EXTRAS = ("gen", "gen64", "vae", "gen-dense")
 
 
 def main(argv=None) -> int:
@@ -106,6 +109,18 @@ def main(argv=None) -> int:
         if name in ("gen", "gen64"):
             measures[name] = bench.make_gen_measure(
                 batch=64 if name == "gen64" else 8)
+        elif name == "gen-dense":
+            from dalle_pytorch_tpu.ops import attention as _attn
+
+            # the sliced-path choice is baked in at trace time, so patching
+            # around the compile is enough: this measure's XLA program reads
+            # the full cache every step, exactly the pre-slicing sampler
+            orig = _attn.decode_key_positions
+            _attn.decode_key_positions = lambda *a, **k: None
+            try:
+                measures[name] = bench.make_gen_measure(batch=8)
+            finally:
+                _attn.decode_key_positions = orig
         elif name == "vae":
             measures[name] = bench.make_vae_measure()
         else:
