@@ -196,7 +196,7 @@ def pattern_mask_row(pattern: AttnPattern, index, n_k: int,
 
 def decode_key_positions(
         pattern: AttnPattern, index
-) -> Optional[Tuple[jax.Array, jax.Array]]:
+) -> Optional[Tuple[jax.Array, jax.Array, bool]]:
     """Candidate key positions for ONE decode query at (traced) `index`.
 
     Decode queries are always image positions (only image tokens are
@@ -209,27 +209,44 @@ def decode_key_positions(
     bandwidth-bound, so cache traffic is the throughput (the training path
     is unaffected; dense-masked attention there is MXU-optimal).
 
-    Returns traced ``(positions [m] int32, valid [m] bool)`` with m static,
-    or None for variants whose reachable set isn't smaller (full) or isn't
-    position-local (sparse's random blocks).  ``valid`` is essential, not
-    decorative: an out-of-image candidate (a conv row above the raster top)
-    clipped for the gather would ALIAS onto a text position that the text
-    segment already carries — an aliased duplicate passes ``_allowed`` and
-    double-counts that key in the softmax, so image candidates are valid
-    only when their raster row genuinely exists.
+    Returns traced ``(positions [m] int32, valid [m] bool, contiguous)``
+    with m static and ``contiguous`` a STATIC bool, or None for variants
+    whose reachable set isn't smaller (full) or isn't position-local
+    (sparse's random blocks).
+
+    When ``contiguous`` is True the image segment ``positions[T:]`` is the
+    ascending run ``positions[T] + arange(...)`` — the decode step then
+    reads it with one ``dynamic_slice`` (cheap on TPU) instead of a general
+    gather.  Contiguous candidate windows are CLIPPED into the raster
+    (never just range-clipped at gather time): an out-of-image candidate
+    clipped independently of its reported position would ALIAS onto a text
+    position the text segment already carries, pass ``_allowed`` and
+    double-count that key in the softmax.  Clipping the window start keeps
+    reported positions == read positions; any extra in-window keys the
+    query can't reach (shifted conv windows near the raster top, an
+    image-row window under a text-region query) are exact-masked by
+    ``_allowed``.  ``valid`` carries the residual validity for the strided
+    (non-contiguous) conv case, whose out-of-raster rows can't be clipped
+    without breaking the stride.
     """
     T, W = pattern.text_len, pattern.fmap
     v = pattern.variant
     ii = index - T
     ri, ci = ii // W, ii % W
+    contiguous = False
     if v == "axial_row":
-        img = T + ri * W + jnp.arange(W)
-        # ii >= 0: a text-region query (legal through the public decode_step
-        # API) has ri < 0 and its aliased "row" would double-count text keys
-        img_valid = jnp.broadcast_to(ii >= 0, (W,))
+        # clip into the raster: a text-region query (legal through the
+        # public decode_step API) has ri < 0; row 0's keys are then read
+        # but fully masked by _allowed (text queries reach no image keys)
+        row0 = jnp.clip(ri, 0, W - 1)
+        img = T + row0 * W + jnp.arange(W)
+        img_valid = jnp.ones((W,), bool)
+        contiguous = True
     elif v == "axial_col":
+        # ci = ii % W is non-negative even for text-region queries (jnp
+        # remainder semantics), so every candidate is a real image position
         img = T + ci + jnp.arange(W) * W
-        img_valid = jnp.broadcast_to(ii >= 0, (W,))
+        img_valid = jnp.ones((W,), bool)
     elif v == "conv_like":
         pad = ((pattern.kernel - 1) * pattern.dilation + 1) // 2
         # causality kills every row below the query's, so candidates are
@@ -237,15 +254,26 @@ def decode_key_positions(
         # stride; each row is taken whole (W keys) and the window's column
         # extent is enforced by the predicate
         n_rows = pad // pattern.dilation + 1
-        rows = ri - pattern.dilation * jnp.arange(n_rows)
+        if pattern.dilation == 1:
+            # contiguous ascending window [row0, row0 + n_rows), clipped
+            # into the raster; shifted-in future rows are _allowed-masked.
+            # A window taller than the raster (big kernel on a tiny fmap)
+            # degenerates to the whole raster — never a negative clip bound
+            n_rows = min(n_rows, W)
+            row0 = jnp.clip(ri - (n_rows - 1), 0, W - n_rows)
+            rows = row0 + jnp.arange(n_rows)
+            img_valid = jnp.ones((n_rows * W,), bool)
+            contiguous = True
+        else:
+            rows = ri - pattern.dilation * jnp.arange(n_rows)
+            img_valid = jnp.broadcast_to(
+                ((rows >= 0) & (rows < W))[:, None], (n_rows, W)).reshape(-1)
         img = (T + rows[:, None] * W + jnp.arange(W)[None, :]).reshape(-1)
-        img_valid = jnp.broadcast_to(
-            ((rows >= 0) & (rows < W))[:, None], (n_rows, W)).reshape(-1)
     else:  # full: everything is reachable; sparse: random blocks aren't local
         return None
     positions = jnp.concatenate([jnp.arange(T), img]).astype(jnp.int32)
     valid = jnp.concatenate([jnp.ones((T,), bool), img_valid])
-    return positions, valid
+    return positions, valid, contiguous
 
 
 def _scope_key_pad(pattern: AttnPattern, key_mask, n_k: int):
@@ -388,17 +416,46 @@ class MultiHeadAttention(nn.Module):
         scale = self.dim_head ** -0.5
         sliced = decode_key_positions(self.pattern, index)
         if sliced is not None:
-            # sliced-cache decode: gather only the reachable keys (text +
+            # sliced-cache decode: read only the reachable keys (text +
             # row/col/neighborhood) — the decode loop is HBM-bound on cache
             # reads, and the axial/conv patterns reach ~10% of the cache.
             # Same math as the dense path: softmax over the masked subset
             # equals softmax over the masked full row (excluded entries
             # contribute exp(-inf) = 0).
-            positions, valid = sliced
-            valid = valid & (positions >= 0) & (positions < n_k)
-            safe = jnp.clip(positions, 0, n_k - 1)
-            k_sub = jnp.take(cache_k, safe, axis=2)  # [b, h, m, dh]
-            v_sub = jnp.take(cache_v, safe, axis=2)
+            positions, valid, contiguous = sliced
+            T = self.pattern.text_len
+            if contiguous:
+                # text prefix (static slice) + one dynamic_slice for the
+                # image window — cheaper on TPU than a general gather.  The
+                # window start is clamped so the slice stays inside the
+                # cache (the padded grid is one longer than the cache, so
+                # the last image row's window overruns by one), and the
+                # mask is computed from the positions ACTUALLY read — a
+                # clamp-shifted window must never be scored under the
+                # unshifted positions.  Shifted-in keys below T would
+                # duplicate the text segment, hence the img_actual >= T
+                # validity.
+                m_img = positions.shape[0] - T
+                start = jnp.clip(positions[T], 0, n_k - m_img)
+                img_actual = start + jnp.arange(m_img)
+                positions = jnp.concatenate(
+                    [jnp.arange(T), img_actual]).astype(jnp.int32)
+                valid = jnp.concatenate(
+                    [jnp.ones((T,), bool), img_actual >= T])
+
+                def seg(cache):
+                    return jnp.concatenate(
+                        [cache[:, :, :T],
+                         jax.lax.dynamic_slice_in_dim(cache, start, m_img,
+                                                      axis=2)], axis=2)
+
+                k_sub, v_sub = seg(cache_k), seg(cache_v)
+                safe = positions  # all in [0, n_k) by the clamp above
+            else:
+                valid = valid & (positions >= 0) & (positions < n_k)
+                safe = jnp.clip(positions, 0, n_k - 1)
+                k_sub = jnp.take(cache_k, safe, axis=2)  # [b, h, m, dh]
+                v_sub = jnp.take(cache_v, safe, axis=2)
             dots = jnp.einsum("bhid,bhjd->bhij", q * scale, k_sub,
                               preferred_element_type=jnp.float32)
             row = (_allowed(self.pattern, index, positions, jnp)

@@ -151,6 +151,32 @@ def test_attention_forward_decode_equivalence():
                     err_msg=f"{variant} pos {i} mask={mask is not None}")
 
 
+def test_decode_equivalence_window_taller_than_raster():
+    """conv_like with a kernel window taller than the fmap: the contiguous
+    decode window degenerates to the whole raster and its clamped start
+    lands one position INTO the text region (cache is one shorter than the
+    padded grid) — the shifted-in text key must not be double-counted
+    against the text segment."""
+    rng = jax.random.PRNGKey(3)
+    T, W = 4, 2
+    seq = (T - 1) + W * W
+    pattern = AttnPattern(variant="conv_like", seq_len=seq, text_len=T,
+                          fmap=W, kernel=5)
+    attn = MultiHeadAttention(pattern=pattern, dim=16, heads=2, dim_head=8)
+    x = jax.random.normal(rng, (2, seq, 16))
+    params = attn.init(rng, x)
+    out_full, (k, v) = attn.apply(params, x, return_kv=True)
+    ck = jnp.zeros((2, 2, seq, 8)).at[:, :, :1].set(k[:, :, :1])
+    cv = jnp.zeros((2, 2, seq, 8)).at[:, :, :1].set(v[:, :, :1])
+    for i in range(1, seq):
+        out_i, ck, cv = attn.apply(
+            params, x[:, i: i + 1], ck, cv, jnp.asarray(i),
+            method=MultiHeadAttention.decode_step)
+        np.testing.assert_allclose(
+            np.asarray(out_i[:, 0]), np.asarray(out_full[:, i]),
+            rtol=2e-4, atol=2e-5, err_msg=f"pos {i}")
+
+
 def test_key_pad_mask_full_variant():
     pattern = make_pattern("full")
     attn = MultiHeadAttention(pattern=pattern, dim=16, heads=2, dim_head=8)
