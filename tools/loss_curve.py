@@ -59,11 +59,24 @@ def make_synthetic_pairs(rng, num_pairs, text_len, vocab, image_seq,
     return caps.astype(np.int32), codes.astype(np.int32)
 
 
+# default values for sig fields added AFTER a checkpoint was written: a
+# stored sig missing such a key is compatible iff the current run uses the
+# default (the stored run could only have used it)
+_SIG_LATER_DEFAULTS = {"plateau_threshold": 1e-4}
+
+
 def _config_sig(args):
     """Fields that must match for a checkpoint to be resumable."""
     return {k: getattr(args, k) for k in
             ("batch_size", "learning_rate", "num_pairs", "seed", "templates",
-             "noise", "lr_plateau", "plateau_factor", "plateau_patience")}
+             "noise", "lr_plateau", "plateau_factor", "plateau_patience",
+             "plateau_threshold")}
+
+
+def _sig_compatible(stored: dict, current: dict) -> bool:
+    return all(
+        stored.get(k, _SIG_LATER_DEFAULTS.get(k)) == v
+        for k, v in current.items())
 
 
 def main(argv=None):
@@ -80,6 +93,11 @@ def main(argv=None):
                              "loss, as train_dalle.py does (ref :415-416)")
     parser.add_argument("--plateau_factor", type=float, default=0.5)
     parser.add_argument("--plateau_patience", type=int, default=5)
+    parser.add_argument("--plateau_threshold", type=float, default=1e-4,
+                        help="relative improvement below this counts as a "
+                             "bad epoch (torch's default 1e-4 only fires on "
+                             "a true stall; raise it to demonstrate firing "
+                             "on a converged-but-still-creeping curve)")
     parser.add_argument("--out", type=str,
                         default="all-logs-tpu/synthetic-cub.txt")
     parser.add_argument("--ckpt", type=str, default=None,
@@ -136,7 +154,8 @@ def main(argv=None):
     tx = make_optimizer(args.learning_rate)
     opt_state = jax.jit(tx.init)(params)
     sched = ReduceLROnPlateau(args.learning_rate, factor=args.plateau_factor,
-                              patience=args.plateau_patience)
+                              patience=args.plateau_patience,
+                              threshold=args.plateau_threshold)
 
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
@@ -156,7 +175,7 @@ def main(argv=None):
         meta = json.loads(state["meta"])
         log_lines = (out.read_text().splitlines(keepends=True)
                      if out.exists() else [])
-        if meta["sig"] != _config_sig(args):
+        if not _sig_compatible(meta["sig"], _config_sig(args)):
             print(f"checkpoint {ckpt} config mismatch; starting fresh",
                   flush=True)
         elif len(log_lines) < meta["next_step"]:
