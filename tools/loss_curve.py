@@ -169,9 +169,18 @@ def main(argv=None):
     start_step = 0
     epoch_sum, epoch_cnt = 0.0, 0  # running epoch-mean accumulator
     if ckpt is not None and ckpt.exists():
-        state = serialization.from_bytes(
-            {"params": params, "opt_state": opt_state, "meta": ""},
-            ckpt.read_bytes())
+        try:
+            state = serialization.from_bytes(
+                {"params": params, "opt_state": opt_state, "meta": ""},
+                ckpt.read_bytes())
+        except (ValueError, KeyError) as e:
+            # a checkpoint whose param tree no longer matches this build
+            # (e.g. written before a model-layout migration).  Refuse
+            # loudly instead of silently restarting: a fresh start would
+            # truncate the log this checkpoint was extending.
+            raise SystemExit(
+                f"checkpoint {ckpt} does not match this build's param "
+                f"layout ({e}); delete it to start the run fresh") from None
         meta = json.loads(state["meta"])
         log_lines = (out.read_text().splitlines(keepends=True)
                      if out.exists() else [])
