@@ -1,0 +1,82 @@
+#!/bin/bash
+# Chip-work babysitter: drain the on-chip measurement queue through a flaky
+# TPU tunnel (see PERF.md "Pending on-chip A/Bs" and
+# all-logs-tpu/README.md for why this exists: the tunnel alternates short
+# up-windows with hours-long outages, and a wedged tunnel hangs inside
+# device calls with no exception — only subprocess timeouts bound it).
+#
+# Run DETACHED and re-armable at any time (stages are idempotent via
+# marker files, loss_curve resumes from its checkpoint, and the persistent
+# XLA compile cache makes retries cheap):
+#
+#   nohup setsid tools/chip_babysitter.sh >> /tmp/chipwork.log 2>&1 &
+#
+# Stage logs land in /tmp/chip_<stage>.log with /tmp/chip_<stage>.ok
+# markers; a harvest loop (below, started alongside) copies finished logs
+# into all-logs-tpu/chip-logs/ so an end-of-round commit captures them
+# even when the window arrives after the working session ended.  After a
+# window: fold the A/B logs via tools/collect_ab.py into PERF.md and flip
+# measured winners into bench.py::cub200_config.
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 75 python -c "import jax, jax.numpy as jnp; v=float((jnp.ones((128,128))@jnp.ones((128,128))).sum()); assert v==128.0**3" \
+    >/dev/null 2>&1
+}
+
+wait_tunnel() {
+  until probe; do echo "$(date +%T) tunnel down, sleeping 120s"; sleep 120; done
+  echo "$(date +%T) tunnel up"
+}
+
+run_stage() { # run_stage <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  [ -f "/tmp/chip_${name}.ok" ] && { echo "$name already done"; return 0; }
+  local tries=0
+  while [ $tries -lt 4 ]; do
+    wait_tunnel
+    echo "$(date +%T) starting $name (try $((tries+1))/4)"
+    if timeout "$tmo" "$@" > "/tmp/chip_${name}.log" 2>&1; then
+      echo "$(date +%T) $name DONE"; touch "/tmp/chip_${name}.ok"
+      return 0
+    fi
+    echo "$(date +%T) $name failed rc=$?"
+    tries=$((tries+1))
+    sleep 30
+  done
+  echo "$(date +%T) $name GAVE UP"
+  return 1
+}
+
+# harvest loop: finished stage logs -> committable repo path
+(
+  mkdir -p all-logs-tpu/chip-logs
+  while true; do
+    for ok in /tmp/chip_*.ok; do
+      [ -e "$ok" ] || continue
+      name=$(basename "$ok" .ok)
+      log="/tmp/${name}.log"
+      dst="all-logs-tpu/chip-logs/${name#chip_}.log"
+      if [ -f "$log" ] && [ ! -f "$dst" ]; then
+        cp "$log" "$dst"
+        echo "$(date +%T) harvested $name"
+      fi
+    done
+    sleep 180
+  done
+) &
+
+run_stage ab_core   1500 python tools/perf_ab.py baseline bf16-logits+onehot --reps 3
+run_stage ab_knobs  1500 python tools/perf_ab.py baseline full-head onehot-embed --reps 2
+run_stage ab_batch  1500 python tools/perf_ab.py baseline batch64 batch128 --reps 2
+run_stage ab_cand   1500 python tools/perf_ab.py baseline candidate --reps 3
+run_stage bench     2400 env BENCH_VAE=1 python bench.py
+run_stage bench64   1800 env BENCH_BATCH=64 python bench.py
+run_stage ab_pallas 1500 python tools/perf_ab.py baseline pallas --reps 3
+run_stage loss_tpu  2400 python tools/loss_curve.py --steps 1632 --num_pairs 1632 \
+  --batch_size 16 --lr_plateau --plateau_patience 3 \
+  --out all-logs-tpu/synthetic-cub-tpu.txt
+run_stage ab_ptiles 1500 python tools/perf_ab.py pallas pallas-b64 pallas-b256 --reps 2
+run_stage ab_fmap   1800 python tools/perf_ab.py fmap64 fmap64-pallas --reps 2
+run_stage gen_ab    1800 python tools/perf_ab.py gen gen-dense gen64 vae --reps 2
+echo "$(date +%T) all chip work finished"
