@@ -222,7 +222,7 @@ def make_vae_measure(steps: int = 20, batch: int = 8):
     return _scan_measure(run_steps, params, opt_state, rng, steps, batch)
 
 
-def make_gen_measure(batch: int = 8):
+def make_gen_measure(batch: int = 8, **overrides):
     """Compile the jitted KV-cache sampler once; each ``measure()`` call
     returns ``(image_tokens_per_sec, dt)``.
 
@@ -232,21 +232,27 @@ def make_gen_measure(batch: int = 8):
     use ``make_gen_measure_deferred`` — this convenience form compiles
     eagerly for callers with one generous bound (perf_ab under the
     babysitter's stage timeout)."""
-    compile_fn, _ = make_gen_measure_deferred(batch)
+    compile_fn, _ = make_gen_measure_deferred(batch, **overrides)
     return compile_fn()
 
 
-def make_gen_measure_deferred(batch: int = 8):
+def make_gen_measure_deferred(batch: int = 8, **overrides):
     """Build the sampler without touching the device; returns
     ``(compile_fn, cfg)`` where ``compile_fn()`` pays the decode-scan
     compile (persistent-cache-warm on retry) and returns the ``measure``
     closure — so a watchdog can give compile and measurement their own
     deadlines (the compile can legitimately take several minutes through
-    the tunnel; a *measurement* that slow means a wedge)."""
+    the tunnel; a *measurement* that slow means a wedge).  ``overrides``
+    replace DALLEConfig fields (e.g. ``sliced_kv_decode=False`` for the
+    dense-cache A/B control)."""
+    import dataclasses
+
     from dalle_pytorch_tpu import DALLE
     from dalle_pytorch_tpu.models.dalle import generate_codes
 
     cfg = cub200_config()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
     model = DALLE(cfg)
 
     def compile_fn():
@@ -507,7 +513,14 @@ def main():
     # attempt cheap); the measure bound stays tight because a slow *measure*
     # means a wedge, not a compile.
     gen_compile_s = float(os.environ.get("BENCH_GEN_COMPILE_TIMEOUT_S", 900))
-    for gen_batch in (8, 64):
+    # BENCH_GEN_BATCHES selects which gen batches run ("" skips the stage
+    # entirely): two cold decode-scan compiles at the default 900s bound
+    # can outlive a babysitter stage timeout, so the queue runs one batch
+    # per stage (the other lands via perf_ab's gen64).
+    gen_batches = tuple(
+        int(b) for b in
+        os.environ.get("BENCH_GEN_BATCHES", "8,64").split(",") if b.strip())
+    for gen_batch in gen_batches:
         compile_fn, _ = make_gen_measure_deferred(batch=gen_batch)
         gen_measure = bounded_stage(
             f"generation-b{gen_batch}-compile", compile_fn,

@@ -93,13 +93,17 @@ class DALLEConfig:
     # head is stored per-phase either way (PhaseLogits), so tp meshes keep
     # the sliced path: each phase kernel tp-shards on its own vocab dim.
     head_phase_sliced: bool = True
+    # Decode-time cache-read strategy (ops/attention.py::decode_key_positions):
+    # True gathers only the reachable keys per step, False streams the full
+    # cache — the measured A/B control (tools/perf_ab.py `gen-dense`).
+    sliced_kv_decode: bool = True
     dtype: Any = jnp.float32
 
     # execution-plan fields stripped from checkpoint hparams (like dtype):
     # they select how the same params are computed, not what the model is
     _PLAN_FIELDS = ("ring_axis", "sp_impl", "sp_size",
                     "ff_expert_dispatch", "ff_expert_capacity_factor",
-                    "head_phase_sliced")
+                    "head_phase_sliced", "sliced_kv_decode")
 
     @property
     def image_seq_len(self) -> int:
@@ -186,17 +190,31 @@ class PhaseLogits(nn.Module):
     def __call__(self, x, image_only: bool = False, text_only: bool = False):
         assert not (image_only and text_only)
         num_image = self.total - self.total_text
-        parts = []
+        # Both phase kernels are created on EVERY call path: a module
+        # initialized through a phase-only caller (e.g. prefill's
+        # image_only head) must still own the full param tree, or a later
+        # full-checkpoint load would find half the head missing.  Unused
+        # kernels cost nothing — XLA dead-code-eliminates the untouched
+        # matmul inputs from the compiled program.
+        phases = {
+            "text": (self.param("text_kernel", nn.initializers.lecun_normal(),
+                                (x.shape[-1], self.total_text), jnp.float32),
+                     self.param("text_bias", nn.initializers.zeros,
+                                (self.total_text,), jnp.float32)),
+            "image": (self.param("image_kernel",
+                                 nn.initializers.lecun_normal(),
+                                 (x.shape[-1], num_image), jnp.float32),
+                      self.param("image_bias", nn.initializers.zeros,
+                                 (num_image,), jnp.float32)),
+        }
+        wanted = []
         if not image_only:  # text phase wanted
-            parts.append(("text_kernel", "text_bias", self.total_text))
+            wanted.append("text")
         if not text_only:   # image phase wanted
-            parts.append(("image_kernel", "image_bias", num_image))
+            wanted.append("image")
         outs = []
-        for kname, bname, width in parts:
-            kernel = self.param(kname, nn.initializers.lecun_normal(),
-                                (x.shape[-1], width), jnp.float32)
-            bias = self.param(bname, nn.initializers.zeros, (width,),
-                              jnp.float32)
+        for phase in wanted:
+            kernel, bias = phases[phase]
             if self.bf16_matmul:
                 outs.append(jnp.dot(x.astype(jnp.bfloat16),
                                     kernel.astype(jnp.bfloat16),
@@ -242,6 +260,7 @@ def transformer_kwargs(cfg: DALLEConfig) -> dict:
         pallas_block_q=cfg.pallas_block_q,
         pallas_block_k=cfg.pallas_block_k,
         ring_axis=cfg.ring_axis, sp_impl=cfg.sp_impl,
+        sliced_kv_decode=cfg.sliced_kv_decode,
         ff_experts=cfg.ff_experts, ff_expert_top_k=cfg.ff_expert_top_k,
         ff_expert_dispatch=cfg.ff_expert_dispatch,
         ff_expert_capacity_factor=cfg.ff_expert_capacity_factor,

@@ -316,6 +316,10 @@ class MultiHeadAttention(nn.Module):
     pallas_block_k: int = 128   # tools/perf_ab.py pallas-b* variants
     ring_axis: Optional[str] = None  # sequence-parallel axis (inside shard_map)
     sp_impl: str = "ring"            # 'ring' (k/v rotation) | 'ulysses' (all-to-all)
+    sliced_kv_decode: bool = True    # decode reads only reachable keys
+    #   (decode_key_positions); False streams the full cache — the A/B
+    #   control for the sliced path, selectable per-build so the choice is
+    #   part of the traced config, never a monkeypatch around the compile
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -414,7 +418,8 @@ class MultiHeadAttention(nn.Module):
                                                (0, 0, index, 0))
         n_k = cache_k.shape[2]
         scale = self.dim_head ** -0.5
-        sliced = decode_key_positions(self.pattern, index)
+        sliced = (decode_key_positions(self.pattern, index)
+                  if self.sliced_kv_decode else None)
         if sliced is not None:
             # sliced-cache decode: read only the reachable keys (text +
             # row/col/neighborhood) — the decode loop is HBM-bound on cache

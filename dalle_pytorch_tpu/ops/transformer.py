@@ -51,6 +51,7 @@ class AttnBlock(nn.Module):
     pallas_block_k: int = 128
     ring_axis: Optional[str] = None
     sp_impl: str = "ring"
+    sliced_kv_decode: bool = True
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -62,7 +63,8 @@ class AttnBlock(nn.Module):
             pallas_block_q=self.pallas_block_q,
             pallas_block_k=self.pallas_block_k,
             ring_axis=self.ring_axis,
-            sp_impl=self.sp_impl, dtype=self.dtype,
+            sp_impl=self.sp_impl,
+            sliced_kv_decode=self.sliced_kv_decode, dtype=self.dtype,
             name="attn",
         )
         self.scale = self.param(
@@ -181,6 +183,7 @@ class Transformer(nn.Module):
     pallas_block_k: int = 128
     ring_axis: Optional[str] = None  # sequence-parallel axis (inside shard_map)
     sp_impl: str = "ring"            # 'ring' | 'ulysses' (all-to-all)
+    sliced_kv_decode: bool = True    # decode gathers only reachable keys
     ff_experts: int = 0        # >1: MoE feed-forward with this many experts
     ff_expert_top_k: int = 2
     ff_expert_dispatch: str = "dense"        # 'dense' | 'capacity'
@@ -212,6 +215,7 @@ class Transformer(nn.Module):
                 pallas_block_q=self.pallas_block_q,
                 pallas_block_k=self.pallas_block_k,
                 ring_axis=self.ring_axis, sp_impl=self.sp_impl,
+                sliced_kv_decode=self.sliced_kv_decode,
                 dtype=self.dtype,
                 name=f"layers_{ind}_attn",
             ))

@@ -303,35 +303,26 @@ def test_perf_ab_tool(monkeypatch, capsys):
     # the batch64 variant's override must actually reach make_train_measure
     assert seen_batches == {16: True, 64: True}
 
-    seen_gen_batches = []
+    seen_gen_calls = []
     real_mgm = bench.make_gen_measure
 
-    def spying_mgm(batch=8):
-        seen_gen_batches.append(batch)
-        return real_mgm(batch=batch)
+    def spying_mgm(batch=8, **overrides):
+        seen_gen_calls.append((batch, overrides))
+        return real_mgm(batch=batch, **overrides)
 
     monkeypatch.setattr(bench, "make_gen_measure", spying_mgm)
     assert perf_ab.main(["gen", "gen64", "--reps", "1"]) == 0
     out = capsys.readouterr().out
     assert "tok/s" in out
-    assert seen_gen_batches == [8, 64]
+    assert seen_gen_calls == [(8, {}), (64, {})]
 
-    # gen-dense compiles the sampler with the sliced-KV decode disabled,
-    # and MUST restore the real decode_key_positions afterwards
-    from dalle_pytorch_tpu.ops import attention as attn_mod
-
-    real_dkp = attn_mod.decode_key_positions
-    patched_during_build = []
-
-    def spying_mgm2(batch=8):
-        patched_during_build.append(
-            attn_mod.decode_key_positions(None, None) is None)
-        return real_mgm(batch=batch)
-
-    monkeypatch.setattr(bench, "make_gen_measure", spying_mgm2)
+    # gen-dense must select the dense-cache control through the CONFIG
+    # (sliced_kv_decode=False) — the choice rides the traced model config,
+    # so a retrace can never silently measure the sliced path (the r3
+    # monkeypatch-around-the-compile approach this replaced)
+    seen_gen_calls.clear()
     assert perf_ab.main(["gen-dense", "--reps", "1"]) == 0
-    assert patched_during_build == [True]
-    assert attn_mod.decode_key_positions is real_dkp
+    assert seen_gen_calls == [(8, {"sliced_kv_decode": False})]
 
 
 def test_perf_ab_rejects_bad_args(monkeypatch, capsys):

@@ -252,3 +252,39 @@ def test_full_head_loss_matches_sliced():
     a = float(dalle.apply(params, text, codes, return_loss=True))
     b = float(dalle_full.apply(params, text, codes, return_loss=True))
     assert np.allclose(a, b, rtol=1e-6), (a, b)
+
+
+def test_dense_decode_control_matches_sliced():
+    """sliced_kv_decode=False (the perf A/B control: decode streams the
+    full cache every step) must sample the identical greedy tokens as the
+    default sliced-cache decode — the flag selects the cache-read strategy,
+    never the math.  This is the config-level control tools/perf_ab.py's
+    ``gen-dense`` measures."""
+    import dataclasses
+
+    cfg, dalle, params, text, _ = build(
+        attn_types=("full", "axial_row", "axial_col", "conv_like"), depth=4)
+    assert cfg.sliced_kv_decode
+    dalle_dense = DALLE(dataclasses.replace(cfg, sliced_kv_decode=False))
+    thres = 1.0 - 1.0 / cfg.total_tokens  # greedy: k=1
+    a = np.asarray(generate_codes(dalle, params, text, jax.random.PRNGKey(0),
+                                  filter_thres=thres))
+    b = np.asarray(generate_codes(dalle_dense, params, text,
+                                  jax.random.PRNGKey(0), filter_thres=thres))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_phase_head_init_call_path_independent():
+    """Initializing through a phase-only head caller (prefill computes only
+    image-phase logits) must still create BOTH phase kernels — otherwise a
+    model first used for generation couldn't load a full training
+    checkpoint (param tree mismatch on the missing phase)."""
+    cfg, dalle, params, text, _ = build()
+    pre_params = dalle.init(jax.random.PRNGKey(0), text,
+                            method=DALLE.prefill)
+    full_head = params["params"]["to_logits_dense"]
+    pre_head = pre_params["params"]["to_logits_dense"]
+    assert set(pre_head) == set(full_head) == {
+        "text_kernel", "text_bias", "image_kernel", "image_bias"}
+    for k in full_head:
+        assert pre_head[k].shape == full_head[k].shape, k

@@ -12,12 +12,26 @@
 #   nohup setsid tools/chip_babysitter.sh >> /tmp/chipwork.log 2>&1 &
 #
 # Stage logs land in /tmp/chip_<stage>.log with /tmp/chip_<stage>.ok
-# markers; a harvest loop (below, started alongside) copies finished logs
-# into all-logs-tpu/chip-logs/ so an end-of-round commit captures them
-# even when the window arrives after the working session ended.  After a
-# window: fold the A/B logs via tools/collect_ab.py into PERF.md and flip
-# measured winners into bench.py::cub200_config.
+# markers; a harvest loop (started alongside, lifecycle-bounded: it exits
+# once every stage is harvested and is killed at script exit either way)
+# copies finished logs into all-logs-tpu/chip-logs/ so an end-of-round
+# commit captures them even when the window arrives after the working
+# session ended.  After a window: fold the A/B logs via
+# tools/collect_ab.py into PERF.md and flip measured winners into
+# bench.py::cub200_config.
+#
+# Stages are ordered by evidence value per tunnel-minute: a short window
+# should produce the candidate-stack decision, the headline bench record,
+# and the sliced-KV generation A/B before anything else runs.
 cd "$(dirname "$0")/.."
+
+# Queue version: markers are per-version (chip_<stage>.v${QV}.ok) so a
+# re-armed queue whose stage COMMANDS changed can never be skipped by a
+# stale marker from an older queue definition — bump QV whenever any
+# stage's command line changes.
+QV=7
+
+STAGES="ab_cand bench gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap"
 
 probe() {
   timeout 75 python -c "import jax, jax.numpy as jnp; v=float((jnp.ones((128,128))@jnp.ones((128,128))).sum()); assert v==128.0**3" \
@@ -31,16 +45,17 @@ wait_tunnel() {
 
 run_stage() { # run_stage <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
-  [ -f "/tmp/chip_${name}.ok" ] && { echo "$name already done"; return 0; }
+  [ -f "/tmp/chip_${name}.v${QV}.ok" ] && { echo "$name already done"; return 0; }
   local tries=0
   while [ $tries -lt 4 ]; do
     wait_tunnel
     echo "$(date +%T) starting $name (try $((tries+1))/4)"
     if timeout "$tmo" "$@" > "/tmp/chip_${name}.log" 2>&1; then
-      echo "$(date +%T) $name DONE"; touch "/tmp/chip_${name}.ok"
+      echo "$(date +%T) $name DONE"; touch "/tmp/chip_${name}.v${QV}.ok"
       return 0
     fi
-    echo "$(date +%T) $name failed rc=$?"
+    local rc=$?  # before any other command: rc=124 means the stage timeout
+    echo "$(date +%T) $name failed rc=$rc"
     tries=$((tries+1))
     sleep 30
   done
@@ -48,35 +63,64 @@ run_stage() { # run_stage <name> <timeout_s> <cmd...>
   return 1
 }
 
-# harvest loop: finished stage logs -> committable repo path
-(
+harvest_once() { # finished stage logs -> committable repo path
   mkdir -p all-logs-tpu/chip-logs
-  while true; do
-    for ok in /tmp/chip_*.ok; do
-      [ -e "$ok" ] || continue
-      name=$(basename "$ok" .ok)
-      log="/tmp/${name}.log"
-      dst="all-logs-tpu/chip-logs/${name#chip_}.log"
-      if [ -f "$log" ] && [ ! -f "$dst" ]; then
+  local name ok log dst all_done=1
+  for name in $STAGES; do
+    ok="/tmp/chip_${name}.v${QV}.ok"; log="/tmp/chip_${name}.log"
+    dst="all-logs-tpu/chip-logs/${name}.log"
+    if [ -e "$ok" ]; then
+      # copy when missing OR when the stage re-ran under a newer queue
+      # version (-nt): a stale harvested file from an older queue must
+      # never shadow the re-run's results
+      if [ -f "$log" ] && { [ ! -f "$dst" ] || [ "$log" -nt "$dst" ]; }; then
         cp "$log" "$dst"
         echo "$(date +%T) harvested $name"
       fi
-    done
+    else
+      all_done=0
+    fi
+  done
+  return $all_done  # rc 1 = everything harvested
+}
+
+# background harvest loop, lifecycle-bounded (ADVICE r3: the r3 loop was
+# unkillable and leaked one copy per re-arm): exits on its own once all
+# stages are harvested, and the EXIT trap kills it when the queue script
+# ends for any other reason (a GAVE-UP stage never gets an .ok marker).
+(
+  while true; do
+    harvest_once || exit 0
     sleep 180
   done
 ) &
+HARVEST_PID=$!
+trap 'harvest_once; kill "$HARVEST_PID" 2>/dev/null' EXIT
 
+# -- the queue, highest evidence value first -------------------------------
+# candidate stack: the one A/B that decides the production config flip
+run_stage ab_cand   1500 python tools/perf_ab.py baseline candidate --reps 3
+# headline bench record (writes all-logs-tpu/bench-history.jsonl): one gen
+# batch only — two cold decode-scan compiles can outlive the stage timeout
+run_stage bench     2400 env BENCH_VAE=1 BENCH_GEN_BATCHES=8 python bench.py
+# sliced-KV decode A/B (north-star #2): gen vs its dense-cache control.
+# batch 64 is a SEPARATE stage — each variant here is a cold decode-scan
+# compile (bench.py bounds ONE at 900s), so two per stage is the ceiling
+run_stage gen_ab    2400 python tools/perf_ab.py gen gen-dense --reps 2
+run_stage gen64_ab  1800 python tools/perf_ab.py gen64 --reps 2
+# candidate headline at batch 64 (no gen stages — gen_ab covers them)
+run_stage bench64   1500 env BENCH_BATCH=64 BENCH_GEN_BATCHES= python bench.py
+# lever attribution: bf16 head + onehot embed, separately and together
 run_stage ab_core   1500 python tools/perf_ab.py baseline bf16-logits+onehot --reps 3
 run_stage ab_knobs  1500 python tools/perf_ab.py baseline full-head onehot-embed --reps 2
-run_stage ab_batch  1500 python tools/perf_ab.py baseline batch64 batch128 --reps 2
-run_stage ab_cand   1500 python tools/perf_ab.py baseline candidate --reps 3
-run_stage bench     2400 env BENCH_VAE=1 python bench.py
-run_stage bench64   1800 env BENCH_BATCH=64 python bench.py
+# flagship Pallas kernel: prove or re-target (VERDICT r3 weak #2)
 run_stage ab_pallas 1500 python tools/perf_ab.py baseline pallas --reps 3
-run_stage loss_tpu  2400 python tools/loss_curve.py --steps 1632 --num_pairs 1632 \
-  --batch_size 16 --lr_plateau --plateau_patience 3 \
+# loss parity at the reference geometry: 654 iters/epoch x 16 epochs on
+# the real chip (resumable: a dropped window costs one 50-step chunk)
+run_stage loss_tpu  2400 python tools/loss_curve.py --steps 10464 --num_pairs 10464 \
+  --batch_size 16 --lr_plateau \
   --out all-logs-tpu/synthetic-cub-tpu.txt
 run_stage ab_ptiles 1500 python tools/perf_ab.py pallas pallas-b64 pallas-b256 --reps 2
+run_stage ab_batch  1500 python tools/perf_ab.py baseline batch64 batch128 --reps 2
 run_stage ab_fmap   1800 python tools/perf_ab.py fmap64 fmap64-pallas --reps 2
-run_stage gen_ab    1800 python tools/perf_ab.py gen gen-dense gen64 vae --reps 2
 echo "$(date +%T) all chip work finished"

@@ -110,17 +110,12 @@ def main(argv=None) -> int:
             measures[name] = bench.make_gen_measure(
                 batch=64 if name == "gen64" else 8)
         elif name == "gen-dense":
-            from dalle_pytorch_tpu.ops import attention as _attn
-
-            # the sliced-path choice is baked in at trace time, so patching
-            # around the compile is enough: this measure's XLA program reads
-            # the full cache every step, exactly the pre-slicing sampler
-            orig = _attn.decode_key_positions
-            _attn.decode_key_positions = lambda *a, **k: None
-            try:
-                measures[name] = bench.make_gen_measure(batch=8)
-            finally:
-                _attn.decode_key_positions = orig
+            # the dense-cache control: the same sampler with
+            # DALLEConfig.sliced_kv_decode=False, so the choice is part of
+            # the traced config — a retrace can never silently measure the
+            # sliced path under the gen-dense label
+            measures[name] = bench.make_gen_measure(batch=8,
+                                                    sliced_kv_decode=False)
         elif name == "vae":
             measures[name] = bench.make_vae_measure()
         else:
