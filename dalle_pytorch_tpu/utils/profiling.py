@@ -89,6 +89,42 @@ def dalle_train_flops(cfg, batch: int) -> float:
         vocab=cfg.total_tokens, batch=batch, logits_flops=logits_fwd)
 
 
+def compiled_cost_summary(fn, *args, donate_argnums=(),
+                          static_argnums=()) -> dict:
+    """Compile ``fn(*args)`` (no execution, no device memory) and return
+    XLA's own per-step cost model:
+
+    ``flops``            HLO-level floating-point operation count
+    ``bytes_accessed``   the cost model's total memory traffic.  NOTE:
+                         XLA's accounting is per-op and pre-fusion-naive —
+                         an operand read by k ops is counted k times — so
+                         treat it as a *regression signal*, not achievable
+                         HBM traffic; compare builds, don't quote it.
+    ``temp_bytes``       peak temporary allocation of the compiled program
+    ``argument_bytes`` / ``output_bytes``  I/O footprint
+
+    This is the chip-independent half of the perf story: the same numbers
+    XLA computes on any backend, so FLOPs/traffic/memory regressions are
+    caught by CPU-only CI runs without a TPU in the loop (the wall-clock
+    half lives in bench.py / tools/perf_ab.py).  The analytic
+    ``dalle_train_flops`` is validated against this path (96.4% agreement
+    at the CUB geometry, tests/test_perf_model.py)."""
+    compiled = jax.jit(fn, donate_argnums=donate_argnums,
+                       static_argnums=static_argnums).lower(*args).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    out = {"flops": ca.get("flops", 0.0),
+           "bytes_accessed": ca.get("bytes accessed", 0.0)}
+    try:
+        ma = compiled.memory_analysis()
+        out.update(temp_bytes=ma.temp_size_in_bytes,
+                   argument_bytes=ma.argument_size_in_bytes,
+                   output_bytes=ma.output_size_in_bytes)
+    except Exception:  # pragma: no cover — backends without memory analysis
+        pass
+    return out
+
+
 class StepTimer:
     """Wall-clock step timer with EMA, images/sec and MFU reporting.
 

@@ -1,0 +1,243 @@
+"""Compiler-model perf gates: XLA cost_analysis regression tests.
+
+Three rounds of dead TPU tunnels made wall-clock evidence unreliable, so
+the perf invariants that matter are pinned here against XLA's own cost
+model (``utils.profiling.compiled_cost_summary``), which is identical
+math on every backend — a regression that lands in the production step,
+the candidate stack, or the sliced-KV decode fails in CPU-only CI, no
+chip required.  The wall-clock half of the story stays in bench.py /
+tools/perf_ab.py; PERF.md records these numbers as "compiler-model, not
+wall-clock".
+
+Calibration (XLA:CPU, jax 0.8.x, 2026-08; PERF.md "Compiler-model
+gates" table):
+
+* production train step (CUB geometry, batch 16):
+  flops 2.380e12, bytes 1.981e11, temp 14.46 GiB; analytic/xla = 0.964
+* candidate stack (batch 64 + bf16 head + one-hot embeds):
+  flops 1.011e13 (4.25x the b16 step: 4x batch + the one-hot embed
+  matmuls), analytic/xla = 0.907
+* full-head control (head_phase_sliced=False):
+  flops 2.596e12 (sliced head saves 8.3%), temp 18.67 GiB (+4.2 GiB —
+  the [b, n, total_vocab] logits/grads the sliced head never builds)
+* decode step (batch 8): the sliced-KV path's bytes-per-cache-key
+  derivative is variant-independent update plumbing (~114.7 kB/key);
+  the dense control adds ~35.4 kB/key of cache *streaming* on top.
+  At n=1105 that streaming is ~21x the sliced path's whole reachable
+  read set ((81 text + 32 row) keys) — the cache-traffic claim behind
+  the sliced decode (ops/attention.py::decode_key_positions), asserted
+  here as a derivative so XLA's per-op double-counting cancels out.
+
+Bands are deliberately loose (a jax upgrade may shift costs a few
+percent); a real regression — losing the phase-sliced head, breaking
+decode_key_positions, an accidental f32 blow-up — moves them far more.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dalle_pytorch_tpu import DALLE, DALLEConfig
+from dalle_pytorch_tpu.ops.attention import AttnPattern, MultiHeadAttention
+from dalle_pytorch_tpu.training import make_dalle_train_step, make_optimizer
+from dalle_pytorch_tpu.utils.profiling import (compiled_cost_summary,
+                                               dalle_train_flops)
+
+GiB = 2 ** 30
+
+
+def cub_train_costs(batch=16, **overrides):
+    """Cost summary of the production train step at the bench geometry."""
+    import bench
+
+    cfg = bench.cub200_config()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    model = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (batch, cfg.text_seq_len), 0,
+                              cfg.num_text_tokens)
+    codes = jax.random.randint(rng, (batch, cfg.image_seq_len), 0,
+                               cfg.num_image_tokens)
+    params = jax.jit(
+        lambda r: model.init(r, text[:1], codes[:1])["params"])(rng)
+    tx = make_optimizer(3e-4)
+    opt = jax.jit(tx.init)(params)
+    raw = make_dalle_train_step(model, tx, jit=False)
+    return compiled_cost_summary(raw, params, opt, None, text, codes,
+                                 rng), cfg
+
+
+def layer_decode_costs(variant, sliced, n_cache, batch=8, fmap=32, text=81):
+    """Cost summary of ONE attention layer's KV-cache decode step.
+
+    ``n_cache`` can exceed the pattern's padded length: extra keys are
+    mask-dead, so growing it isolates d(bytes)/d(cache key) — the pure
+    cache-traffic component, free of XLA's fixed per-op accounting."""
+    n = text - 1 + fmap * fmap
+    pat = AttnPattern(variant=variant, seq_len=n, text_len=text, fmap=fmap)
+    m = MultiHeadAttention(pattern=pat, dim=256, heads=8, dim_head=64,
+                           sliced_kv_decode=sliced, dtype=jnp.bfloat16)
+    x = jnp.zeros((batch, 1, 256), jnp.bfloat16)
+    ck = jnp.zeros((batch, 8, n_cache, 64), jnp.bfloat16)
+    cv = jnp.zeros_like(ck)
+    idx = jnp.asarray(text + 5 * fmap + 3)  # an interior image position
+    params = m.init(jax.random.PRNGKey(0), x, ck, cv, idx,
+                    method=MultiHeadAttention.decode_step)
+
+    def step(params, x, ck, cv, idx):
+        return m.apply(params, x, ck, cv, idx,
+                       method=MultiHeadAttention.decode_step)
+
+    # caches donated, as in the real sampler's scan carry
+    return compiled_cost_summary(step, params, x, ck, cv, idx,
+                                 donate_argnums=(2, 3))
+
+
+def test_cost_summary_smoke():
+    """compiled_cost_summary returns the documented fields on a tiny jit
+    (fast tier: everything else in this module pays CUB-sized compiles)."""
+    out = compiled_cost_summary(lambda a, b: a @ b,
+                                jnp.ones((64, 64)), jnp.ones((64, 64)))
+    assert out["flops"] >= 2 * 64 ** 3 * 0.99
+    assert out["bytes_accessed"] > 0
+    if "temp_bytes" in out:
+        assert out["argument_bytes"] >= 2 * 64 * 64 * 4
+
+
+@pytest.fixture(scope="module")
+def prod():
+    return cub_train_costs(16)
+
+
+@pytest.mark.slow
+def test_production_step_regression_bands(prod):
+    """The headline train step's compiler costs, pinned.  A failure here
+    means the production step got cheaper (update the calibration and
+    PERF.md) or a perf regression landed (fix it) — either way the number
+    moved and the perf story must notice."""
+    costs, cfg = prod
+    assert 0.85 <= dalle_train_flops(cfg, 16) / costs["flops"] <= 1.0
+    assert costs["flops"] == pytest.approx(2.380e12, rel=0.08)
+    assert costs["bytes_accessed"] == pytest.approx(1.981e11, rel=0.15)
+    if "temp_bytes" in costs:
+        assert costs["temp_bytes"] == pytest.approx(14.46 * GiB, rel=0.20)
+
+
+@pytest.mark.slow
+def test_candidate_stack_scales_clean(prod):
+    """The candidate production config (batch 64 + bf16 head + one-hot
+    embeds) must cost ~4x the b16 step plus the embed matmuls — if batch
+    scaling stops being linear (a shape blow-up, a quadratic term), the
+    candidate flip would silently lose its projected MFU win."""
+    costs16, _ = prod
+    costs64, cfg64 = cub_train_costs(64, logits_bf16=True, onehot_embed=True)
+    assert 0.85 <= dalle_train_flops(cfg64, 64) / costs64["flops"] <= 1.0
+    ratio = costs64["flops"] / costs16["flops"]
+    assert 4.0 <= ratio <= 4.5, ratio  # 4x batch + one-hot embed matmuls
+
+
+@pytest.mark.slow
+def test_phase_sliced_head_saves_flops_and_memory(prod):
+    """head_phase_sliced=True must keep both its wins over the full-head
+    control: ~8% step FLOPs and the multi-GiB temp allocation for the
+    [b, n, total_vocab] logits tensor the sliced head never materializes
+    (models/dalle.py::loss_from_hidden)."""
+    sliced, _ = prod
+    full, _ = cub_train_costs(16, head_phase_sliced=False)
+    ratio = sliced["flops"] / full["flops"]
+    assert 0.88 <= ratio <= 0.95, ratio
+    if "temp_bytes" in sliced:
+        saved = full["temp_bytes"] - sliced["temp_bytes"]
+        assert saved >= 3 * GiB, saved / GiB
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("variant,reachable", [
+    ("axial_row", 81 + 32),        # all text + the query's raster row
+    ("conv_like", 81 + 3 * 32),    # all text + kernel//2+1 rows (k=5, d=1)
+])
+def test_sliced_decode_eliminates_cache_streaming(variant, reachable):
+    """The sliced-KV decode's cache-traffic claim, as a compiler gate.
+
+    XLA's bytes-accessed totals double-count fixed overhead, so the gate
+    differentiates with respect to cache length: extra keys are mask-dead,
+    and only *streamed* cache reads scale with them.  The sliced path's
+    derivative must be pure update plumbing (identical to the full
+    variant's fixed writes — no read term), while the dense control pays
+    at least the true k+v row reads (2 caches x batch x heads x dh x 2B
+    = 16 kB/key) on top.  At the CUB cache length, the streaming the
+    sliced path eliminates must be >= 8x its whole reachable read set —
+    the "~10x less cache traffic" line in PERF.md, made falsifiable."""
+    n_k, n_k2 = 1105, 2210
+    key_row_bytes = 2 * 8 * 8 * 64 * 2  # k+v rows: batch x heads x dh, bf16
+
+    d_sliced = (layer_decode_costs(variant, True, n_k2)["bytes_accessed"]
+                - layer_decode_costs(variant, True, n_k)["bytes_accessed"]
+                ) / (n_k2 - n_k)
+    d_dense = (layer_decode_costs(variant, False, n_k2)["bytes_accessed"]
+               - layer_decode_costs(variant, False, n_k)["bytes_accessed"]
+               ) / (n_k2 - n_k)
+
+    streaming = (d_dense - d_sliced) * n_k      # what slicing eliminates
+    sliced_reads = reachable * key_row_bytes    # what slicing still reads
+    assert d_dense - d_sliced >= key_row_bytes, (d_dense, d_sliced)
+    assert streaming >= 8 * sliced_reads, (streaming, sliced_reads)
+
+
+@pytest.mark.slow
+def test_full_variant_ignores_decode_flag():
+    """The full pattern has no reachable-subset structure: both flag
+    settings must compile to the same costs (decode_key_positions returns
+    None), so flipping the flag can never change full-attention layers."""
+    a = layer_decode_costs("full", True, 1105)
+    b = layer_decode_costs("full", False, 1105)
+    assert a["flops"] == b["flops"]
+    assert a["bytes_accessed"] == b["bytes_accessed"]
+
+
+@pytest.mark.slow
+def test_model_decode_step_sliced_cheaper():
+    """End-to-end decode step (8-layer CUB stack, 6 sliced-eligible
+    layers): the sliced build must read measurably less than the dense
+    control — at least 6 layers' worth of (1 - reachable fraction) cache
+    reads (~90 MB at this geometry)."""
+    import bench
+
+    def decode_costs(sliced: bool, batch=8):
+        cfg = dataclasses.replace(bench.cub200_config(),
+                                  sliced_kv_decode=sliced)
+        model = DALLE(cfg)
+        rng = jax.random.PRNGKey(0)
+        text = jax.random.randint(rng, (batch, cfg.text_seq_len), 0,
+                                  cfg.num_text_tokens)
+        params = jax.jit(lambda r: model.init(
+            r, text[:1],
+            jnp.zeros((1, cfg.image_seq_len), jnp.int32))["params"])(rng)
+        caches = [(jnp.zeros((batch, cfg.heads, cfg.seq_len, cfg.dim_head),
+                             cfg.dtype),
+                   jnp.zeros((batch, cfg.heads, cfg.seq_len, cfg.dim_head),
+                             cfg.dtype))
+                  for _ in range(cfg.depth)]
+        code = jnp.zeros((batch,), jnp.int32)
+        idx = jnp.asarray(cfg.text_seq_len + 5)
+
+        def step(params, code, caches, idx):
+            return model.apply({"params": params}, code, caches, idx,
+                               method=DALLE.decode_step)
+
+        return compiled_cost_summary(step, params, code, caches, idx,
+                                     donate_argnums=(2,)), cfg
+
+    sliced, cfg = decode_costs(True)
+    dense, _ = decode_costs(False)
+    cache_bytes = 8 * cfg.heads * cfg.seq_len * cfg.dim_head * 2  # bf16
+    # 6 of 8 CUB layers are sliced-eligible; each stops streaming ~90% of
+    # its k+v caches
+    expected_floor = 6 * 2 * cache_bytes * 0.8
+    saved = dense["bytes_accessed"] - sliced["bytes_accessed"]
+    assert saved >= expected_floor, (saved, expected_floor)
+    assert sliced["flops"] <= dense["flops"]

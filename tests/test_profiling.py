@@ -84,38 +84,8 @@ def test_transformer_flops_terms():
     assert long_seq > short_seq * 16  # quadratic attention term visible
 
 
-@pytest.mark.slow
-def test_analytic_flops_within_band_of_xla_cost_analysis():
-    """dalle_train_flops must stay a slight UNDER-estimate of XLA's own
-    per-step FLOP count (cost_analysis of the compiled production train
-    step): the analytic model is matmul-only, so it should land in
-    [0.85, 1.0] of XLA's count.  Pins MFU credibility — measured 96.4% at
-    the CUB geometry on XLA:CPU (PERF.md), the same backend conftest forces
-    for this suite, so the band is calibrated on what actually runs here."""
-    import jax
-
-    import bench
-    from dalle_pytorch_tpu import DALLE
-    from dalle_pytorch_tpu.training import (make_dalle_train_step,
-                                            make_optimizer)
-
-    cfg = bench.cub200_config()
-    model = DALLE(cfg)
-    rng = jax.random.PRNGKey(0)
-    text = jax.random.randint(rng, (16, cfg.text_seq_len), 0,
-                              cfg.num_text_tokens)
-    codes = jax.random.randint(rng, (16, cfg.image_seq_len), 0,
-                               cfg.num_image_tokens)
-    params = jax.jit(lambda r: model.init(r, text[:1], codes[:1])["params"])(rng)
-    tx = make_optimizer(3e-4)
-    opt_state = jax.jit(tx.init)(params)
-    raw = make_dalle_train_step(model, tx, jit=False)
-    compiled = jax.jit(raw).lower(params, opt_state, None, text, codes,
-                                  rng).compile()
-    ca = compiled.cost_analysis()
-    ca = ca[0] if isinstance(ca, list) else ca
-    xla = ca.get("flops")
-    if not xla:  # backend without cost model: nothing to compare
-        pytest.skip("cost_analysis reports no flops on this backend")
-    ratio = dalle_train_flops(cfg, 16) / xla
-    assert 0.85 < ratio <= 1.0, ratio
+# The analytic-vs-XLA cost_analysis band (dalle_train_flops lands in
+# [0.85, 1.0] of the compiler's count — measured 96.4% at the CUB
+# geometry) lives in tests/test_perf_model.py::
+# test_production_step_regression_bands, alongside the other compiler-
+# model gates, so the CUB-sized compile is paid once per slow-tier run.
