@@ -1,11 +1,14 @@
-"""Real multi-process integration tests: two JAX processes on CPU.
+"""Real multi-process integration tests: 2 and 4 JAX processes on CPU.
 
 Everything else in the suite tests distributed behavior single-process on a
-virtual device mesh; these spawn TWO actual `jax.distributed` processes
+virtual device mesh; these spawn actual `jax.distributed` processes
 (the multi-host topology, minus the network) and drive the full train_dalle
 CLI through them — collective checkpoint saves, per-process data sharding,
 cross-process loss averaging, and the collective preemption stop where
-SIGTERM lands on only ONE host.
+SIGTERM lands on only ONE host.  The train and preemption paths run at
+BOTH 2 and 4 ranks: rank-indexing bugs (off-by-one shard math, root-vs-
+"the other process" assumptions) are invisible at 2 processes, where
+every non-root rank is rank 1.
 """
 from __future__ import annotations
 
@@ -25,7 +28,10 @@ pytestmark = pytest.mark.slow  # full tier only (--runslow)
 
 REPO = Path(__file__).resolve().parent.parent
 
-DALLE_HPARAMS = dict(BATCH_SIZE=2, MODEL_DIM=32, TEXT_SEQ_LEN=8, DEPTH=2,
+# BATCH_SIZE is per-host and must satisfy check_batch_size (>= process
+# count), and each process's data shard (32 samples / nprocs) must hold at
+# least one drop_last batch at 4 ranks: 8 >= 4.
+DALLE_HPARAMS = dict(BATCH_SIZE=4, MODEL_DIM=32, TEXT_SEQ_LEN=8, DEPTH=2,
                      HEADS=2, DIM_HEAD=16, ATTN_TYPES=["full", "axial_row"])
 VAE_HPARAMS = dict(EPOCHS=1, BATCH_SIZE=4, NUM_TOKENS=32, NUM_LAYERS=2,
                    NUM_RESNET_BLOCKS=0, EMB_DIM=16, HID_DIM=16)
@@ -48,7 +54,7 @@ def mp_workdir(tmp_path_factory):
     data.mkdir()
     rng = np.random.default_rng(0)
     words = ["red", "green", "blue", "bird"]
-    for i in range(12):
+    for i in range(32):
         img = (rng.uniform(size=(16, 16, 3)) * 255).astype(np.uint8)
         Image.fromarray(img).save(data / f"s{i}.png")
         (data / f"s{i}.txt").write_text(
@@ -81,9 +87,12 @@ def _env(workdir, hparams, n_local_devices: int = 2):
     return env
 
 
-def _spawn_train(workdir, port, pid, extra_args=(), epochs=1):
+def _spawn_train(workdir, port, pid, extra_args=(), epochs=1, nprocs=2):
     """Launch one training process, stdout+stderr to a log file — a PIPE
-    would deadlock if a child filled the buffer while the test polls."""
+    would deadlock if a child filled the buffer while the test polls.
+    Local device count scales down as the process count scales up (2x2 or
+    4x1 = 4 global devices), keeping the global mesh — and the compile
+    cost on the 1-core CI box — constant across parametrizations."""
     args = [sys.executable, str(REPO / "train_dalle.py"),
             "--vae_path", str(workdir / "vae-final.pt"),
             "--image_text_folder", str(workdir / "data"),
@@ -91,11 +100,11 @@ def _spawn_train(workdir, port, pid, extra_args=(), epochs=1):
             "--truncate_captions", "--epochs", str(epochs),
             "--distributed_backend", "gspmd",
             "--coordinator_address", f"127.0.0.1:{port}",
-            "--num_processes", "2", "--process_id", str(pid),
+            "--num_processes", str(nprocs), "--process_id", str(pid),
             *extra_args]
     log = open(workdir / f"proc{pid}.log", "w")
-    proc = subprocess.Popen(args, cwd=workdir,
-                            env=_env(workdir, DALLE_HPARAMS),
+    env = _env(workdir, DALLE_HPARAMS, n_local_devices=4 // nprocs)
+    proc = subprocess.Popen(args, cwd=workdir, env=env,
                             stdout=log, stderr=subprocess.STDOUT, text=True)
     proc._log_path = workdir / f"proc{pid}.log"  # type: ignore[attr-defined]
     proc._log_file = log  # type: ignore[attr-defined]
@@ -118,11 +127,16 @@ def _finish(procs, timeout=900):
     return [p._log_path.read_text() for p in procs]
 
 
-def test_two_process_train(mp_workdir):
-    """Full train_dalle run across 2 real processes (2 devices each):
-    per-process data shards, GSPMD grad sync, collective msgpack save."""
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_multi_process_train(mp_workdir, nprocs):
+    """Full train_dalle run across real processes (4 global devices):
+    per-process data shards, GSPMD grad sync, collective msgpack save.
+    4 ranks catches rank-indexing bugs 2 cannot (every non-root rank is
+    rank 1 at nprocs=2)."""
+    (mp_workdir / "dalle-final.pt").unlink(missing_ok=True)
     port = _free_port()
-    procs = [_spawn_train(mp_workdir, port, pid) for pid in (0, 1)]
+    procs = [_spawn_train(mp_workdir, port, pid, nprocs=nprocs)
+             for pid in range(nprocs)]
     outs = _finish(procs)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-3000:]}"
@@ -132,30 +146,33 @@ def test_two_process_train(mp_workdir):
 
     ckpt = load_checkpoint(mp_workdir / "dalle-final.pt")
     assert set(ckpt) >= {"hparams", "weights", "opt_state", "epoch"}
-    # root prints/logs; non-root stays quiet about epochs
+    # root prints/logs; every non-root rank stays quiet about epochs
     assert "epoch 0 done" in outs[0]
-    assert "epoch 0 done" not in outs[1]
+    for out in outs[1:]:
+        assert "epoch 0 done" not in out
 
 
-def test_two_process_preemption_single_sigterm(mp_workdir):
-    """SIGTERM delivered to only ONE of two processes: the stop decision is
-    collective, so BOTH processes leave the loop at the same step, save one
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_multi_process_preemption_single_sigterm(mp_workdir, nprocs):
+    """SIGTERM delivered to only ONE of the processes: the stop decision is
+    collective, so ALL processes leave the loop at the same step, save one
     coherent resume checkpoint together, and exit cleanly — the multi-host
-    preemption story end-to-end."""
+    preemption story end-to-end.  At 4 ranks the signal lands on a MIDDLE
+    rank (neither root nor last), the case 2 ranks cannot express."""
     for f in ("dalle.pt", "dalle-final.pt"):
         (mp_workdir / f).unlink(missing_ok=True)
     port = _free_port()
-    hb_dir = mp_workdir / "hb"
-    procs = [_spawn_train(mp_workdir, port, pid, epochs=500,
+    hb_dir = mp_workdir / f"hb{nprocs}"
+    procs = [_spawn_train(mp_workdir, port, pid, epochs=500, nprocs=nprocs,
                           extra_args=("--heartbeat_dir", str(hb_dir)))
-             for pid in (0, 1)]
+             for pid in range(nprocs)]
     # wait for training to actually progress (heartbeats appear), then
-    # preempt just the NON-root process
+    # preempt just one NON-root process
     try:
         deadline = time.monotonic() + 600
         while time.monotonic() < deadline:
-            if (hb_dir / "heartbeat-p0.json").exists() and \
-                    (hb_dir / "heartbeat-p1.json").exists():
+            if all((hb_dir / f"heartbeat-p{pid}.json").exists()
+                   for pid in range(nprocs)):
                 break
             for p in procs:
                 assert p.poll() is None, \
@@ -163,7 +180,7 @@ def test_two_process_preemption_single_sigterm(mp_workdir):
             time.sleep(2)
         else:
             raise AssertionError("training never produced heartbeats")
-        procs[1].send_signal(signal.SIGTERM)
+        procs[nprocs // 2].send_signal(signal.SIGTERM)
     except BaseException:
         for p in procs:
             if p.poll() is None:

@@ -460,18 +460,67 @@ def make_clip_twin(W, HEADS, LAYERS, PATCH, IMG, VOCAB, CTX, EMB,
             eot = text.argmax(dim=-1)
             return h[torch.arange(h.shape[0]), eot] @ self.text_projection
 
+        def forward(self, image, text):
+            # the released clip module's forward shape (image/text logits);
+            # gives torch.jit.trace a path through EVERY parameter, so a
+            # traced archive of this twin carries the full state_dict under
+            # the released key names
+            i = self.encode_image(image)
+            t = self.encode_text(text)
+            i = i / i.norm(dim=1, keepdim=True)
+            t = t / t.norm(dim=1, keepdim=True)
+            scale = self.logit_scale.exp()
+            return scale * i @ t.t(), scale * t @ i.t()
+
     return TClip()
 
 
-def test_clip_vit_conversion():
+def _clip_twin_params(model, LAYERS, via_torchscript=None):
+    """state_dict -> converter params, optionally round-tripping the twin
+    through a genuine ``torch.jit.save`` archive first (the released
+    ViT-B-32.pt format) so the conversion consumes what ``_torch_load``'s
+    ``torch.jit.load`` fallback actually returns."""
+    if via_torchscript is None:
+        sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    else:
+        from tools.convert_weights import _torch_load
+
+        with torch.no_grad():
+            traced = torch.jit.trace(
+                model, (torch.randn(1, 3, 16, 16),
+                        torch.zeros((1, 8), dtype=torch.long)))
+        path = via_torchscript / "ViT-B-32.pt"
+        torch.jit.save(traced, str(path))
+        # torch >= 2.x dispatches plain torch.load to jit.load itself (with
+        # a warning); older torch raises RuntimeError, which is what routes
+        # _torch_load into its explicit jit fallback.  Exercise BOTH
+        # routes against this genuine TorchScript archive: the natural one,
+        # and the fallback with plain-load forced to fail like old torch.
+        sd = _torch_load(str(path))
+        import unittest.mock as mock
+
+        with mock.patch.object(
+                torch, "load",
+                side_effect=RuntimeError("ViT-B-32.pt is a zip archive")):
+            sd_fallback = _torch_load(str(path))
+        assert set(sd_fallback) == set(sd)
+        for k in sd:
+            np.testing.assert_array_equal(sd_fallback[k], sd[k])
+    return convert_clip_state_dict(sd, vision_layers=LAYERS,
+                                   text_layers=LAYERS)
+
+
+@pytest.mark.parametrize("torchscript", [False, True],
+                         ids=["state-dict", "torchscript-archive"])
+def test_clip_vit_conversion(torchscript, tmp_path):
     from dalle_pytorch_tpu.models.clip_vit import CLIPViT, CLIPViTConfig
 
     W, HEADS, LAYERS, PATCH, IMG, VOCAB, CTX, EMB = 32, 4, 2, 8, 16, 50, 8, 16
     torch.manual_seed(5)
     model = make_clip_twin(W, HEADS, LAYERS, PATCH, IMG, VOCAB, CTX, EMB)
-    sd = {k: v.numpy() for k, v in model.state_dict().items()}
-    params = convert_clip_state_dict(sd, vision_layers=LAYERS,
-                                     text_layers=LAYERS)
+    params = _clip_twin_params(model, LAYERS,
+                               via_torchscript=tmp_path if torchscript
+                               else None)
 
     cfg = CLIPViTConfig(image_size=IMG, patch_size=PATCH, vision_width=W,
                         vision_layers=LAYERS, vision_heads=HEADS,
