@@ -46,15 +46,19 @@ wait_tunnel() {
 run_stage() { # run_stage <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
   [ -f "/tmp/chip_${name}.v${QV}.ok" ] && { echo "$name already done"; return 0; }
-  local tries=0
+  local tries=0 rc
   while [ $tries -lt 4 ]; do
     wait_tunnel
     echo "$(date +%T) starting $name (try $((tries+1))/4)"
-    if timeout "$tmo" "$@" > "/tmp/chip_${name}.log" 2>&1; then
+    # plain statement + immediate capture: $? read after an un-taken `if`
+    # branch is 0, which would report every failure as rc=0 and destroy
+    # the rc=124 (stage timeout = wedged tunnel) vs crash triage signal
+    timeout "$tmo" "$@" > "/tmp/chip_${name}.log" 2>&1
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
       echo "$(date +%T) $name DONE"; touch "/tmp/chip_${name}.v${QV}.ok"
       return 0
     fi
-    local rc=$?  # before any other command: rc=124 means the stage timeout
     echo "$(date +%T) $name failed rc=$rc"
     tries=$((tries+1))
     sleep 30
