@@ -1,0 +1,63 @@
+"""Shared multi-axis sharding test setup.
+
+One canonical dp2 x fsdp2 x tp2 build of the tiny CUB-shaped DALLE with
+the PRODUCTION Partitioner shardings (parallel/mesh.py — the exact specs
+train_dalle.py and __graft_entry__.dryrun_multichip use), consumed by
+both gates that validate them:
+
+* tests/test_parallel.py::test_sharded_train_step_no_involuntary_resharding
+  (no GSPMD replicate-then-repartition warnings), and
+* tests/test_perf_model.py::test_sharded_step_per_device_costs
+  (per-device compiled FLOPs ~ 1/8 of the unsharded step).
+
+A Partitioner/mesh/config change therefore hits both gates through this
+single setup — they can never drift into validating different shardings.
+"""
+from __future__ import annotations
+
+import jax
+
+from dalle_pytorch_tpu.parallel.mesh import Partitioner, make_mesh
+from dalle_pytorch_tpu.training import make_optimizer
+
+
+def sharded_cub_setup(batch: int = 4, lr: float = 1e-3):
+    """Returns ``(model, cfg, mesh, part, tx, plain, sharded)`` where
+    ``plain`` and ``sharded`` each hold ``params / opt_state / text /
+    codes / rng`` — identical values, host-local vs placed on the
+    dp2 x fsdp2 x tp2 mesh with the production shardings."""
+    import jax.numpy as jnp
+
+    import __graft_entry__ as g
+
+    model, cfg = g._cub_dalle(tiny=True, dtype=jnp.float32)
+    mesh = make_mesh(dp=2, fsdp=2, tp=2, devices=jax.devices()[:8])
+    part = Partitioner(mesh=mesh)
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (batch, cfg.text_seq_len), 0,
+                              cfg.num_text_tokens)
+    codes = jax.random.randint(rng, (batch, cfg.image_seq_len), 0,
+                               cfg.num_image_tokens)
+    params = jax.jit(
+        lambda r: model.init(r, text[:1], codes[:1])["params"])(rng)
+    tx = make_optimizer(lr)
+    step_rng = jax.random.PRNGKey(1)
+
+    class _Plain(dict):
+        """opt_state computed on first access: the resharding-warning gate
+        never touches the unsharded form, so it must not pay the extra
+        jitted tx.init compile on the fast tier."""
+
+        def __missing__(self, key):
+            assert key == "opt_state", key
+            self[key] = jax.jit(tx.init)(params)
+            return self[key]
+
+    plain = _Plain(params=params, text=text, codes=codes, rng=step_rng)
+    params_s = jax.device_put(params, part.param_shardings(params))
+    sharded = dict(params=params_s,
+                   opt_state=part.init_opt_state(tx, params_s),
+                   text=jax.device_put(text, part.data_sharding),
+                   codes=jax.device_put(codes, part.data_sharding),
+                   rng=part.replicate(step_rng))
+    return model, cfg, mesh, part, tx, plain, sharded

@@ -156,28 +156,15 @@ def test_sharded_train_step_no_involuntary_resharding(capfd):
     'Involuntary full rematerialization' warnings — each one is a
     replicate-then-repartition of a tensor every step (wasted ICI bandwidth
     at scale).  Guards the DEFAULT_RULES / opt-state sharding contract."""
-    import __graft_entry__ as g
+    from shard_utils import sharded_cub_setup
 
-    model, cfg = g._cub_dalle(tiny=True, dtype=jnp.float32)
-    mesh = make_mesh(dp=2, fsdp=2, tp=2, devices=jax.devices()[:8])
-    part = Partitioner(mesh=mesh)
-    batch = 4
-    rng = jax.random.PRNGKey(0)
-    text = jax.random.randint(rng, (batch, cfg.text_seq_len), 0,
-                              cfg.num_text_tokens)
-    codes = jax.random.randint(rng, (batch, cfg.image_seq_len), 0,
-                               cfg.num_image_tokens)
-    params = jax.jit(lambda r: model.init(r, text[:1], codes[:1])["params"])(rng)
-    params = jax.device_put(params, part.param_shardings(params))
-    tx = make_optimizer(1e-3)
-    opt_state = part.init_opt_state(tx, params)
-    text = jax.device_put(text, part.data_sharding)
-    codes = jax.device_put(codes, part.data_sharding)
-    step_rng = part.replicate(jax.random.PRNGKey(1))
+    model, cfg, mesh, part, tx, _, sharded = sharded_cub_setup(batch=4)
     train_step = make_dalle_train_step(model, tx, vae=None)
     capfd.readouterr()  # drop anything earlier
     with mesh:
-        _, _, loss = train_step(params, opt_state, None, text, codes, step_rng)
+        _, _, loss = train_step(sharded["params"], sharded["opt_state"],
+                                None, sharded["text"], sharded["codes"],
+                                sharded["rng"])
         loss.block_until_ready()
     assert np.isfinite(float(loss))
     captured = capfd.readouterr()

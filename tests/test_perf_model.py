@@ -200,6 +200,44 @@ def test_full_variant_ignores_decode_flag():
 
 
 @pytest.mark.slow
+def test_sharded_step_per_device_costs():
+    """Sharding-efficiency compiler gate: the production train step jitted
+    over the dp2 x fsdp2 x tp2 mesh (the exact Partitioner shardings the
+    trainers and __graft_entry__.dryrun_multichip use) must compile to a
+    per-device program whose FLOPs are ~1/8 of the unsharded step's.
+    Catches, chip-free, the classic GSPMD regressions: a sharding
+    annotation lost somewhere makes XLA fully replicate the compute
+    (ratio -> 1.0) or force a resharding blow-up — both far outside the
+    band.  Calibration (XLA:CPU, tiny CUB-shaped config): ratio 0.128 vs
+    ideal 0.125, temp-memory ratio 0.19."""
+    from shard_utils import sharded_cub_setup
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    model, cfg, mesh, part, tx, plain, shard = sharded_cub_setup(batch=8)
+    step = make_dalle_train_step(model, tx, jit=False)
+
+    single = compiled_cost_summary(step, plain["params"],
+                                   plain["opt_state"], None, plain["text"],
+                                   plain["codes"], plain["rng"])
+    with mesh:
+        sharded = compiled_cost_summary(step, shard["params"],
+                                        shard["opt_state"], None,
+                                        shard["text"], shard["codes"],
+                                        shard["rng"])
+
+    ratio = sharded["flops"] / single["flops"]
+    assert 1 / 8 <= ratio <= 1.35 / 8, (
+        f"per-device flops ratio {ratio:.3f} vs ideal 0.125: the mesh "
+        "sharding is replicating or resharding compute")
+    if "temp_bytes" in sharded and "temp_bytes" in single:
+        temp_ratio = sharded["temp_bytes"] / single["temp_bytes"]
+        assert temp_ratio <= 0.5, (
+            f"per-device temp memory ratio {temp_ratio:.2f}: activations "
+            "or params no longer shard")
+
+
+@pytest.mark.slow
 def test_model_decode_step_sliced_cheaper():
     """End-to-end decode step (8-layer CUB stack, 6 sliced-eligible
     layers): the sliced build must read measurably less than the dense
