@@ -1,0 +1,132 @@
+"""End-to-end tests of the chip-work babysitter queue machinery.
+
+The babysitter (tools/chip_babysitter.sh) is the round's only path to
+on-chip evidence through the flaky TPU tunnel, and its first real
+execution would otherwise happen unattended during an actual up-window —
+exactly when a bug costs the round its measurements.  These tests drive
+the REAL script end-to-end with a stubbed ``python`` on PATH (instant
+"stages"), a private marker directory (CHIP_TMP — never the production
+/tmp markers an armed queue is using), and second-scale sleeps, proving:
+
+* the full queue runs, marks, and harvests every stage into
+  ``all-logs-tpu/chip-logs/`` and the harvest loop does not outlive the
+  script (the r3 ADVICE leak);
+* re-arming skips completed stages via the versioned markers, and a
+  marker from an OLDER queue version does not skip a redefined stage;
+* a failing stage logs its REAL exit code, retries 4x, gives up without
+  a marker or a harvested log, and does not block later stages.
+"""
+from __future__ import annotations
+
+import os
+import stat
+import subprocess
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+N_STAGES = 12  # keep in sync with STAGES in tools/chip_babysitter.sh
+
+
+def script_qv() -> int:
+    """The queue version declared in the script — parsed, not hardcoded,
+    so a routine QV bump cannot spuriously break these tests."""
+    import re
+
+    text = (REPO / "tools" / "chip_babysitter.sh").read_text()
+    return int(re.search(r"^QV=(\d+)$", text, re.M).group(1))
+
+
+def make_sandbox(tmp_path, python_shim: str):
+    """A private repo skeleton + PATH shim + marker dir for one scenario."""
+    repo = tmp_path / "repo"
+    (repo / "tools").mkdir(parents=True)
+    script = repo / "tools" / "chip_babysitter.sh"
+    script.write_text((REPO / "tools" / "chip_babysitter.sh").read_text())
+    script.chmod(script.stat().st_mode | stat.S_IXUSR)
+
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir(exist_ok=True)
+    shim = shim_dir / "python"
+    shim.write_text(python_shim)
+    shim.chmod(0o755)
+
+    chip_tmp = tmp_path / "chip"
+    chip_tmp.mkdir(exist_ok=True)
+    env = dict(os.environ,
+               PATH=f"{shim_dir}:{os.environ['PATH']}",
+               CHIP_TMP=str(chip_tmp),
+               PROBE_SLEEP="0", RETRY_SLEEP="0", HARVEST_SLEEP="1")
+    return repo, chip_tmp, env
+
+
+def run_queue(repo, env, tmp_path, timeout=120):
+    """Run the script to completion, stdout to a file (a PIPE could block
+    on any straggler child holding the write end open)."""
+    out_path = tmp_path / "queue.log"
+    with out_path.open("w") as out:
+        proc = subprocess.run(["bash", str(repo / "tools" /
+                                          "chip_babysitter.sh")],
+                              env=env, stdout=out, stderr=subprocess.STDOUT,
+                              timeout=timeout)
+    return proc.returncode, out_path.read_text()
+
+
+ALWAYS_OK = "#!/bin/bash\necho \"fake stage: $*\"\nexit 0\n"
+BENCH_FAILS = ("#!/bin/bash\n"
+               "case \"$*\" in *bench.py*) echo boom; exit 7;; esac\n"
+               "echo \"fake stage: $*\"\nexit 0\n")
+
+
+def test_full_queue_runs_marks_and_harvests(tmp_path):
+    repo, chip_tmp, env = make_sandbox(tmp_path, ALWAYS_OK)
+    rc, out = run_queue(repo, env, tmp_path)
+    assert rc == 0, out[-2000:]
+    assert "all chip work finished" in out
+    markers = sorted(p.name for p in chip_tmp.glob("chip_*.ok"))
+    assert len(markers) == N_STAGES, markers
+    harvested = sorted(p.name for p in
+                       (repo / "all-logs-tpu" / "chip-logs").glob("*.log"))
+    assert len(harvested) == N_STAGES, harvested
+    # value-ordering: the candidate A/B must be the FIRST stage to run
+    assert out.index("starting ab_cand") < out.index("starting bench ")
+    # the harvest loop must not outlive the script (r3 ADVICE leak):
+    # no process still has our sandbox in its command line
+    ps = subprocess.run(["ps", "-eo", "args"], capture_output=True,
+                        text=True).stdout
+    assert str(repo) not in ps
+
+
+def test_rearm_skips_completed_stages(tmp_path):
+    repo, chip_tmp, env = make_sandbox(tmp_path, ALWAYS_OK)
+    run_queue(repo, env, tmp_path)
+    rc, out = run_queue(repo, env, tmp_path, timeout=60)
+    assert rc == 0
+    assert out.count("already done") == N_STAGES
+    assert "starting" not in out  # nothing re-ran
+
+
+def test_stale_old_version_marker_does_not_skip(tmp_path):
+    repo, chip_tmp, env = make_sandbox(tmp_path, ALWAYS_OK)
+    qv = script_qv()
+    (chip_tmp / f"chip_ab_cand.v{qv - 1}.ok").touch()  # older queue's marker
+    rc, out = run_queue(repo, env, tmp_path)
+    assert rc == 0
+    assert "starting ab_cand" in out  # the redefined stage still ran
+    assert (chip_tmp / f"chip_ab_cand.v{qv}.ok").exists()
+
+
+def test_failed_stage_reports_rc_retries_and_gives_up(tmp_path):
+    repo, chip_tmp, env = make_sandbox(tmp_path, BENCH_FAILS)
+    rc, out = run_queue(repo, env, tmp_path)
+    # both bench stages fail; everything else completes and harvests
+    qv = script_qv()
+    for stage in ("bench", "bench64"):
+        assert f"{stage} failed rc=7" in out  # the REAL exit code
+        assert out.count(f"starting {stage} ") == 4  # retried 4x
+        assert f"{stage} GAVE UP" in out
+        assert not (chip_tmp / f"chip_{stage}.v{qv}.ok").exists()
+        assert not (repo / "all-logs-tpu" / "chip-logs" /
+                    f"{stage}.log").exists()
+    harvested = list((repo / "all-logs-tpu" / "chip-logs").glob("*.log"))
+    assert len(harvested) == N_STAGES - 2
+    assert "all chip work finished" in out  # later stages not blocked

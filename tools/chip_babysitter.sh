@@ -11,7 +11,7 @@
 #
 #   nohup setsid tools/chip_babysitter.sh >> /tmp/chipwork.log 2>&1 &
 #
-# Stage logs land in /tmp/chip_<stage>.log with /tmp/chip_<stage>.ok
+# Stage logs land in ${CHIP_TMP}/chip_<stage>.log with ${CHIP_TMP}/chip_<stage>.ok
 # markers; a harvest loop (started alongside, lifecycle-bounded: it exits
 # once every stage is harvested and is killed at script exit either way)
 # copies finished logs into all-logs-tpu/chip-logs/ so an end-of-round
@@ -33,19 +33,27 @@ QV=7
 
 STAGES="ab_cand bench gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap"
 
+# Overridable knobs so tests/test_babysitter.py can drive the REAL script
+# (fake python on PATH, private marker dir, second-scale sleeps) without
+# touching the production /tmp markers an armed queue is using.
+CHIP_TMP=${CHIP_TMP:-/tmp}
+PROBE_SLEEP=${PROBE_SLEEP:-120}
+RETRY_SLEEP=${RETRY_SLEEP:-30}
+HARVEST_SLEEP=${HARVEST_SLEEP:-180}
+
 probe() {
   timeout 75 python -c "import jax, jax.numpy as jnp; v=float((jnp.ones((128,128))@jnp.ones((128,128))).sum()); assert v==128.0**3" \
     >/dev/null 2>&1
 }
 
 wait_tunnel() {
-  until probe; do echo "$(date +%T) tunnel down, sleeping 120s"; sleep 120; done
+  until probe; do echo "$(date +%T) tunnel down, sleeping ${PROBE_SLEEP}s"; sleep "$PROBE_SLEEP"; done
   echo "$(date +%T) tunnel up"
 }
 
 run_stage() { # run_stage <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
-  [ -f "/tmp/chip_${name}.v${QV}.ok" ] && { echo "$name already done"; return 0; }
+  [ -f "${CHIP_TMP}/chip_${name}.v${QV}.ok" ] && { echo "$name already done"; return 0; }
   local tries=0 rc
   while [ $tries -lt 4 ]; do
     wait_tunnel
@@ -53,15 +61,17 @@ run_stage() { # run_stage <name> <timeout_s> <cmd...>
     # plain statement + immediate capture: $? read after an un-taken `if`
     # branch is 0, which would report every failure as rc=0 and destroy
     # the rc=124 (stage timeout = wedged tunnel) vs crash triage signal
-    timeout "$tmo" "$@" > "/tmp/chip_${name}.log" 2>&1
+    timeout "$tmo" "$@" > "${CHIP_TMP}/chip_${name}.log" 2>&1
     rc=$?
     if [ "$rc" -eq 0 ]; then
-      echo "$(date +%T) $name DONE"; touch "/tmp/chip_${name}.v${QV}.ok"
+      echo "$(date +%T) $name DONE"; touch "${CHIP_TMP}/chip_${name}.v${QV}.ok"
       return 0
     fi
     echo "$(date +%T) $name failed rc=$rc"
     tries=$((tries+1))
-    sleep 30
+    # no sleep after the FINAL failure: the next stage should get the
+    # remaining tunnel window immediately
+    [ $tries -lt 4 ] && sleep "$RETRY_SLEEP"
   done
   echo "$(date +%T) $name GAVE UP"
   return 1
@@ -71,7 +81,7 @@ harvest_once() { # finished stage logs -> committable repo path
   mkdir -p all-logs-tpu/chip-logs
   local name ok log dst all_done=1
   for name in $STAGES; do
-    ok="/tmp/chip_${name}.v${QV}.ok"; log="/tmp/chip_${name}.log"
+    ok="${CHIP_TMP}/chip_${name}.v${QV}.ok"; log="${CHIP_TMP}/chip_${name}.log"
     dst="all-logs-tpu/chip-logs/${name}.log"
     if [ -e "$ok" ]; then
       # copy when missing OR when the stage re-ran under a newer queue
@@ -95,7 +105,7 @@ harvest_once() { # finished stage logs -> committable repo path
 (
   while true; do
     harvest_once || exit 0
-    sleep 180
+    sleep "$HARVEST_SLEEP"
   done
 ) &
 HARVEST_PID=$!
