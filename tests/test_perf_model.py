@@ -238,6 +238,52 @@ def test_sharded_step_per_device_costs():
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("impl,sp", [("ring", 4), ("ulysses", 2)])
+def test_sequence_parallel_per_device_costs(impl, sp):
+    """Sequence-parallelism compiler gate: the sp train step over a
+    dp x sp mesh of 8 devices must compile to ~1/8 the dense step's
+    per-device FLOPs.  Ring pays exactness recompute and Ulysses the
+    all-to-all reshuffles, and both duplicate the (cheap) embedding and
+    run the full-vocab head per shard (_sp_loss), so the band allows up
+    to 60% overhead over ideal — but a broken shard_map that
+    rematerializes the full sequence per device lands at ~1.0/dp, far
+    outside it.  Calibration (XLA:CPU, tiny config): ring 0.159,
+    ulysses 0.146 vs ideal 0.125."""
+    import __graft_entry__ as g
+    from dalle_pytorch_tpu.parallel.mesh import make_mesh
+    from dalle_pytorch_tpu.training import make_dalle_sp_train_step
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    _, base = g._cub_dalle(tiny=True, dtype=jnp.float32)
+    tx = make_optimizer(1e-3)
+    mesh = make_mesh(sp=sp, devices=jax.devices()[:8])
+    cfg = dataclasses.replace(base, ring_axis="sp", sp_impl=impl,
+                              sp_size=sp)
+    model = DALLE(cfg)
+    dense = DALLE(dataclasses.replace(cfg, ring_axis=None, sp_size=1))
+    batch = mesh.shape["dp"]
+    text, codes = g._tiny_dalle_inputs(cfg, batch)  # the dryrun's inputs
+    params = jax.jit(
+        lambda r: dense.init(r, text[:1], codes[:1])["params"])(
+        jax.random.PRNGKey(0))
+    opt = jax.jit(tx.init)(params)
+
+    dense_step = make_dalle_train_step(dense, tx, jit=False)
+    single = compiled_cost_summary(dense_step, params, opt, None, text,
+                                   codes, jax.random.PRNGKey(0))
+    sp_step = make_dalle_sp_train_step(model, tx, mesh, donate=False)
+    with mesh:
+        sharded = compiled_cost_summary(sp_step, params, opt, None, text,
+                                        codes, jax.random.PRNGKey(2))
+    ratio = sharded["flops"] / single["flops"]
+    n_dev = 8
+    assert 1 / n_dev <= ratio <= 1.6 / n_dev, (
+        f"{impl} per-device flops ratio {ratio:.3f} vs ideal "
+        f"{1 / n_dev:.3f}: sequence sharding is replicating compute")
+
+
+@pytest.mark.slow
 def test_model_decode_step_sliced_cheaper():
     """End-to-end decode step (8-layer CUB stack, 6 sliced-eligible
     layers): the sliced build must read measurably less than the dense
