@@ -53,7 +53,8 @@ DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
 
 
 def make_mesh(dp: Optional[int] = None, fsdp: int = 1, tp: int = 1,
-              devices=None, dcn_dp: int = 1, sp: int = 1, pp: int = 1) -> Mesh:
+              devices=None, dcn_dp: int = 1, sp: int = 1, pp: int = 1,
+              ep: int = 1) -> Mesh:
     """Build a ('dp','fsdp','tp') mesh.  `dp=None` absorbs remaining devices.
 
     ``dcn_dp > 1`` targets multi-slice topologies (TPU pods joined over the
@@ -63,17 +64,20 @@ def make_mesh(dp: Optional[int] = None, fsdp: int = 1, tp: int = 1,
     cross DCN, while fsdp/tp collectives stay entirely on ICI.  ``dp`` counts
     the *total* data-parallel ways (ICI ways x dcn_dp).
 
-    ``sp > 1`` / ``pp > 1`` instead build a ('dp','sp') or ('dp','pp') mesh
-    for sequence-parallel (ring/Ulysses shard_map) or pipeline-parallel
-    (GPipe shard_map) training — those strategies own their inner axis via
-    manual collectives, so they are mutually exclusive with each other and
-    with fsdp/tp/dcn_dp in one mesh.
+    ``sp > 1`` / ``pp > 1`` / ``ep > 1`` instead build a ('dp','sp') /
+    ('dp','pp') / ('dp','ep') mesh for sequence-parallel (ring/Ulysses
+    shard_map), pipeline-parallel (GPipe shard_map), or expert-parallel
+    (ep-sharded MoE kernels, ops/moe.py::ep_shard_moe_params) training —
+    those strategies own their inner axis, so they are mutually exclusive
+    with each other and with fsdp/tp/dcn_dp in one mesh.
     """
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
-    if sp > 1 or pp > 1:
-        inner_name, inner = ("sp", sp) if sp > 1 else ("pp", pp)
-        assert sp == 1 or pp == 1, "sp and pp are mutually exclusive"
+    if sp > 1 or pp > 1 or ep > 1:
+        inner_name, inner = (("sp", sp) if sp > 1 else
+                             ("pp", pp) if pp > 1 else ("ep", ep))
+        assert (sp > 1) + (pp > 1) + (ep > 1) == 1, (
+            "sp, pp and ep are mutually exclusive")
         assert fsdp == 1 and tp == 1 and dcn_dp == 1, (
             f"{inner_name} cannot be combined with fsdp/tp/dcn_dp in one mesh")
         assert n % inner == 0, f"{n} devices not divisible by {inner_name}={inner}"
