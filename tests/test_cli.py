@@ -233,6 +233,25 @@ def test_train_dalle_pipeline_cli(trained_vae, tiny_dataset,
     assert np.isfinite(_first_loss(wd))
 
 
+@pytest.mark.slow
+def test_train_dalle_fp16_cli(trained_vae, tiny_dataset, tiny_tokenizer_json,
+                              tmp_path_factory):
+    """`train_dalle.py --fp16` (the reference's mixed-precision flag,
+    ref train_dalle.py:55; here it selects bf16 compute — no loss scaling
+    needed on TPU) trains end-to-end: finite losses, loadable float32
+    checkpoint (params are kept f32; only compute runs bf16)."""
+    wd = tmp_path_factory.mktemp("fp16_cli")
+    _run_train_dalle(wd, DALLE_HPARAMS, ["--fp16"], trained_vae,
+                     tiny_dataset, tiny_tokenizer_json)
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    ckpt = load_checkpoint(wd / "dalle-final.pt")
+    assert np.isfinite(_first_loss(wd))
+    kernel = ckpt["weights"]["transformer"]["layers_0_attn"]["attn"][
+        "to_qkv"]["kernel"]
+    assert np.asarray(kernel).dtype == np.float32  # params stay f32
+
+
 @pytest.mark.parametrize("dispatch_args", [
     [],  # dense default
     # capacity dispatch stays covered in the fast tier by test_moe; the
