@@ -178,6 +178,47 @@ def test_loss_curve_resume_bit_identical(monkeypatch, tmp_path):
     assert out.read_text() == fresh.read_text()
 
 
+def test_loss_curve_real_caption_pairs(monkeypatch):
+    """--captions real builds pairs from the BUNDLED CUB data (30k real
+    captions + the 7800-token BPE): right shapes/geometry, deterministic
+    under the seed, and the code template is a function of caption CONTENT
+    (identical captions map to identical templates) — the conditional
+    structure the trainer must learn."""
+    from pathlib import Path
+
+    import numpy as np
+
+    monkeypatch.syspath_prepend(str(Path(__file__).resolve().parent.parent
+                                    / "tools"))
+    from loss_curve import make_real_caption_pairs
+
+    rng = np.random.default_rng(0)
+    caps, codes = make_real_caption_pairs(rng, 64, text_len=80,
+                                          image_seq=256, image_vocab=1024)
+    assert caps.shape == (64, 80) and codes.shape == (64, 256)
+    assert caps.dtype == np.int32 and codes.dtype == np.int32
+    assert (0 <= caps).all() and (caps < 7800).all()
+    assert (0 <= codes).all() and (codes < 1024).all()
+    # real captions: non-pad prefixes of varying length, pad-0 tails
+    lengths = (caps != 0).sum(axis=1)
+    assert lengths.min() >= 2 and len(set(lengths.tolist())) > 3
+    # deterministic under the seed
+    caps2, codes2 = make_real_caption_pairs(
+        np.random.default_rng(0), 64, text_len=80, image_seq=256,
+        image_vocab=1024)
+    np.testing.assert_array_equal(caps, caps2)
+    np.testing.assert_array_equal(codes, codes2)
+    # the codes must carry template structure (few distinct underlying
+    # rows + noise), not be i.i.d. uniform: with 32 templates over 64
+    # pairs, some pair of captions shares a template, and those rows agree
+    # in ~(1-noise)^2 of positions — i.i.d. uniform rows would agree in
+    # ~1/1024.  Check the max pairwise agreement is far above chance.
+    agree = max(
+        float((codes[i] == codes[j]).mean())
+        for i in range(0, 32) for j in range(i + 1, 32))
+    assert agree > 0.5, agree
+
+
 def test_loss_curve_plateau_lr_lands_in_log(monkeypatch, tmp_path):
     """The logged lr column must carry the ReduceLROnPlateau output: with
     lr=0 the params never change, so epoch means repeat EXACTLY, the

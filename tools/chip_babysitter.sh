@@ -29,7 +29,7 @@ cd "$(dirname "$0")/.."
 # re-armed queue whose stage COMMANDS changed can never be skipped by a
 # stale marker from an older queue definition — bump QV whenever any
 # stage's command line changes.
-QV=7
+QV=8
 
 STAGES="ab_cand bench gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap"
 
@@ -130,10 +130,12 @@ run_stage ab_knobs  1500 python tools/perf_ab.py baseline full-head onehot-embed
 # flagship Pallas kernel: prove or re-target (VERDICT r3 weak #2)
 run_stage ab_pallas 1500 python tools/perf_ab.py baseline pallas --reps 3
 # loss parity at the reference geometry: 654 iters/epoch x 16 epochs on
-# the real chip (resumable: a dropped window costs one 50-step chunk)
-run_stage loss_tpu  2400 python tools/loss_curve.py --steps 10464 --num_pairs 10464 \
+# the real chip, REAL bundled CUB captions for the text half (resumable:
+# a dropped window costs one 50-step chunk)
+run_stage loss_tpu  2400 python tools/loss_curve.py --captions real \
+  --steps 10464 --num_pairs 10464 \
   --batch_size 16 --lr_plateau \
-  --out all-logs-tpu/synthetic-cub-tpu.txt
+  --out all-logs-tpu/cub-captions-tpu.txt
 run_stage ab_ptiles 1500 python tools/perf_ab.py pallas pallas-b64 pallas-b256 --reps 2
 run_stage ab_batch  1500 python tools/perf_ab.py baseline batch64 batch128 --reps 2
 run_stage ab_fmap   1800 python tools/perf_ab.py fmap64 fmap64-pallas --reps 2

@@ -50,19 +50,56 @@ def make_synthetic_pairs(rng, num_pairs, text_len, vocab, image_seq,
     instead of requiring per-pair memorization.  Conditional floor:
     ~(ln V_text + 7*(noise*ln V_img + H(noise)))/8 ~ 2.0."""
     caps = rng.integers(1, vocab, size=(num_pairs, text_len))
-    tmpl_of_cap = caps[:, 0] % templates
+    return caps.astype(np.int32), _codes_for(rng, caps[:, 0], image_seq,
+                                             image_vocab, templates, noise)
+
+
+def _codes_for(rng, tmpl_src, image_seq, image_vocab, templates, noise):
+    """Template codes selected by the 1-D per-pair template source
+    (caption content), observed under noise."""
+    tmpl_of_cap = tmpl_src % templates
     templates_codes = rng.integers(0, image_vocab,
                                    size=(templates, image_seq))
     codes = templates_codes[tmpl_of_cap]
     flip = rng.random(codes.shape) < noise
     codes = np.where(flip, rng.integers(0, image_vocab, codes.shape), codes)
-    return caps.astype(np.int32), codes.astype(np.int32)
+    return codes.astype(np.int32)
+
+
+def make_real_caption_pairs(rng, num_pairs, text_len, image_seq, image_vocab,
+                            templates=32, noise=0.1):
+    """REAL CUB captions -> synthetic noisy code templates.
+
+    Uses the bundled data artifacts the reference ships
+    (`cub_2011_test_captions.pkl`: 30k real bird captions;
+    `cub200_bpe_vsize_7800.json`: the CUB BPE vocab — both at the repo
+    root, see genrank.py defaults): a deterministic sample of
+    ``num_pairs`` captions, tokenized exactly as train_dalle.py would
+    (pad 0, truncate at ``text_len``).  The text half of the loss is then
+    a REAL language-modeling task with CUB's token statistics; only the
+    image codes remain synthetic (no CUB images exist in this
+    environment).  The code template hashes the whole caption content, so
+    conditioning still has a learnable rule."""
+    import pandas as pd
+
+    from dalle_pytorch_tpu.data.tokenizer import HugTokenizer
+
+    df = pd.read_pickle(REPO / "cub_2011_test_captions.pkl")
+    tok = HugTokenizer(REPO / "cub200_bpe_vsize_7800.json")
+    sel = rng.choice(len(df), size=num_pairs, replace=num_pairs > len(df))
+    texts = [str(c) for c in df["caption"].iloc[sel]]
+    caps = tok.tokenize(texts, context_length=text_len, truncate_text=True)
+    # content hash over the full caption: same caption -> same template
+    tmpl_src = (caps.astype(np.int64)
+                * (np.arange(caps.shape[1]) + 1)).sum(1) % (2 ** 31)
+    return caps, _codes_for(rng, tmpl_src, image_seq, image_vocab,
+                            templates, noise)
 
 
 # default values for sig fields added AFTER a checkpoint was written: a
 # stored sig missing such a key is compatible iff the current run uses the
 # default (the stored run could only have used it)
-_SIG_LATER_DEFAULTS = {"plateau_threshold": 1e-4}
+_SIG_LATER_DEFAULTS = {"plateau_threshold": 1e-4, "captions": "synthetic"}
 
 
 def _config_sig(args):
@@ -70,7 +107,7 @@ def _config_sig(args):
     return {k: getattr(args, k) for k in
             ("batch_size", "learning_rate", "num_pairs", "seed", "templates",
              "noise", "lr_plateau", "plateau_factor", "plateau_patience",
-             "plateau_threshold")}
+             "plateau_threshold", "captions")}
 
 
 def _sig_compatible(stored: dict, current: dict) -> bool:
@@ -88,6 +125,13 @@ def main(argv=None):
                         help="654 iters/epoch x batch 16, as cool-frog-21")
     parser.add_argument("--templates", type=int, default=32)
     parser.add_argument("--noise", type=float, default=0.1)
+    parser.add_argument("--captions", choices=("synthetic", "real"),
+                        default="synthetic",
+                        help="'real' trains on the bundled CUB captions "
+                             "(cub_2011_test_captions.pkl via the bundled "
+                             "BPE): the text loss becomes a real language "
+                             "task with CUB token statistics; codes stay "
+                             "synthetic (no images in this environment)")
     parser.add_argument("--lr_plateau", action="store_true",
                         help="step ReduceLROnPlateau on each epoch-mean "
                              "loss, as train_dalle.py does (ref :415-416)")
@@ -143,10 +187,16 @@ def main(argv=None):
     model = DALLE(cfg)
 
     host = np.random.default_rng(args.seed)
-    caps, codes = make_synthetic_pairs(
-        host, args.num_pairs, cfg.text_seq_len, cfg.num_text_tokens,
-        cfg.image_seq_len, cfg.num_image_tokens,
-        templates=args.templates, noise=args.noise)
+    if args.captions == "real":
+        caps, codes = make_real_caption_pairs(
+            host, args.num_pairs, cfg.text_seq_len, cfg.image_seq_len,
+            cfg.num_image_tokens, templates=args.templates,
+            noise=args.noise)
+    else:
+        caps, codes = make_synthetic_pairs(
+            host, args.num_pairs, cfg.text_seq_len, cfg.num_text_tokens,
+            cfg.image_seq_len, cfg.num_image_tokens,
+            templates=args.templates, noise=args.noise)
 
     rng = jax.random.PRNGKey(args.seed)
     params = jax.jit(lambda r: model.init(
