@@ -1,0 +1,52 @@
+"""Bundled CUB data artifacts and their integrity check.
+
+The repo ships the two data files the reference's CUB CLIs expect
+(ref genrank.py:20-22, generate.py's captions default): the 7800-token
+CUB BPE vocab and `cub_2011_test_captions.pkl` (a pandas DataFrame of
+30k real CUB test captions).  The captions file is a *pickle* — a format
+that executes arbitrary code on load — and it originates outside this
+repo, so every in-repo load of it goes through
+:func:`load_captions_pickle`, which refuses to unpickle a file carrying
+the bundled artifact's name unless its sha256 matches the digest
+recorded here (r4 advisor finding: never routinely execute an unpinned
+untrusted binary).  A *user-supplied* pickle under a different name is
+the user's own trust decision, exactly as in the reference CLI, and is
+loaded as-is.
+"""
+from __future__ import annotations
+
+import hashlib
+from pathlib import Path
+
+CUB_CAPTIONS_NAME = "cub_2011_test_captions.pkl"
+# sha256 of the bundled artifact, recorded at bundle time (round 4).
+CUB_CAPTIONS_SHA256 = (
+    "efde620efb1fb3d9504661341a309388ba225eb0ae9eb241bfa8456c15db9f25")
+
+
+def load_captions_pickle(path):
+    """pd.read_pickle with an integrity gate on the bundled artifact.
+
+    If ``path`` names the bundled CUB captions file (by basename), its
+    sha256 must equal :data:`CUB_CAPTIONS_SHA256` — a swapped or
+    corrupted copy raises before any pickle bytecode runs.  Other
+    filenames load unverified (user-supplied eval sets).
+    """
+    import io
+
+    import pandas as pd
+
+    path = Path(path)
+    if path.name == CUB_CAPTIONS_NAME:
+        # hash and unpickle the SAME in-memory bytes: re-reading from disk
+        # after hashing would leave a swap window between the two reads
+        data = path.read_bytes()
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != CUB_CAPTIONS_SHA256:
+            raise ValueError(
+                f"{path} does not match the recorded sha256 of the bundled "
+                f"CUB captions artifact (got {digest[:12]}…, expected "
+                f"{CUB_CAPTIONS_SHA256[:12]}…); refusing to unpickle an "
+                f"unverified binary")
+        return pd.read_pickle(io.BytesIO(data))
+    return pd.read_pickle(path)

@@ -102,7 +102,9 @@ def main(argv=None):
                 "eval mode needs pandas: pip install 'dalle-pytorch-tpu[eval]'"
             ) from e
 
-        cap_df = pd.read_pickle(args.captions_pickle)
+        # sha256-gated for the bundled artifact; user files load as-is
+        from dalle_pytorch_tpu.data.bundled import load_captions_pickle
+        cap_df = load_captions_pickle(args.captions_pickle)
         all_tokens = tokenizer.tokenize(
             [str(row['caption']) for _, row in cap_df.iterrows()],
             cfg.text_seq_len, truncate_text=True)

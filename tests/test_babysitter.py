@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import stat
 import subprocess
+import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -89,10 +90,17 @@ def test_full_queue_runs_marks_and_harvests(tmp_path):
     assert len(harvested) == N_STAGES, harvested
     # value-ordering: the candidate A/B must be the FIRST stage to run
     assert out.index("starting ab_cand") < out.index("starting bench ")
-    # the harvest loop must not outlive the script (r3 ADVICE leak):
-    # no process still has our sandbox in its command line
-    ps = subprocess.run(["ps", "-eo", "args"], capture_output=True,
-                        text=True).stdout
+    # the harvest loop must not outlive the script (r3 ADVICE leak): no
+    # process still has our sandbox in its command line.  The EXIT trap's
+    # kill is asynchronous, so poll briefly instead of one snapshot (the
+    # dying subshell can linger a moment on a loaded box — r4 advisor).
+    deadline = time.time() + 5.0
+    while True:
+        ps = subprocess.run(["ps", "-eo", "args"], capture_output=True,
+                            text=True).stdout
+        if str(repo) not in ps or time.time() > deadline:
+            break
+        time.sleep(0.2)
     assert str(repo) not in ps
 
 

@@ -89,14 +89,14 @@ def test_bundled_cub_artifacts_resolve_cli_defaults():
     default must resolve in a fresh clone (VERDICT r3 missing #5: the
     reference ships both data files; so do we).  One pickle caption must
     tokenize with the bundled vocab into the geometry the CUB CLIs use."""
-    import pandas as pd
+    from dalle_pytorch_tpu.data.bundled import load_captions_pickle
 
     bpe = REPO / "cub200_bpe_vsize_7800.json"
     pkl = REPO / "cub_2011_test_captions.pkl"
     assert bpe.exists(), "bundled CUB BPE vocab missing"
     assert pkl.exists(), "bundled CUB test-captions pickle missing"
 
-    df = pd.read_pickle(pkl)
+    df = load_captions_pickle(pkl)  # sha256-gated (r4 advisor finding)
     assert {"caption", "fname"} <= set(df.columns)
     assert len(df) == 30000  # the reference eval set: 10 captions x 3k images
 
@@ -108,6 +108,27 @@ def test_bundled_cub_artifacts_resolve_cli_defaults():
     assert (0 <= ids).all() and (ids < 7800).all()
     assert (ids != 0).any(), "caption tokenized to all-pad"
     assert "bird" in tok.decode(ids)
+
+
+def test_bundled_captions_checksum_gate(tmp_path):
+    """A file carrying the bundled captions artifact's NAME but different
+    bytes must be refused before any pickle bytecode runs; an unrelated
+    user filename loads unverified (the reference CLI's contract)."""
+    import pandas as pd
+    import pytest
+
+    from dalle_pytorch_tpu.data.bundled import (CUB_CAPTIONS_NAME,
+                                                load_captions_pickle)
+
+    tampered = tmp_path / CUB_CAPTIONS_NAME
+    tampered.write_bytes(b"\x80\x04not the artifact")
+    with pytest.raises(ValueError, match="sha256"):
+        load_captions_pickle(tampered)
+
+    user = tmp_path / "my_eval_set.pkl"
+    pd.DataFrame({"caption": ["a small bird"], "fname": ["x.jpg"]}
+                 ).to_pickle(user)
+    assert len(load_captions_pickle(user)) == 1
 
 
 def test_native_bpe_matches_python(synthetic_bpe):

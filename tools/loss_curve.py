@@ -80,11 +80,10 @@ def make_real_caption_pairs(rng, num_pairs, text_len, image_seq, image_vocab,
     image codes remain synthetic (no CUB images exist in this
     environment).  The code template hashes the whole caption content, so
     conditioning still has a learnable rule."""
-    import pandas as pd
-
+    from dalle_pytorch_tpu.data.bundled import load_captions_pickle
     from dalle_pytorch_tpu.data.tokenizer import HugTokenizer
 
-    df = pd.read_pickle(REPO / "cub_2011_test_captions.pkl")
+    df = load_captions_pickle(REPO / "cub_2011_test_captions.pkl")
     tok = HugTokenizer(REPO / "cub200_bpe_vsize_7800.json")
     sel = rng.choice(len(df), size=num_pairs, replace=num_pairs > len(df))
     texts = [str(c) for c in df["caption"].iloc[sel]]
