@@ -443,6 +443,15 @@ def flash_pattern_attention(q, k, v, pattern: AttnPattern,
     (CPU/GPU correctness runs) has no VMEM limit.
     """
     b, _, n, dh = q.shape
+    if (block_q % 128 or block_k % 128) and not interpret:
+        # Mosaic requires the last block dim be a multiple of the 128-lane
+        # width (the lse output [b, h, n] blocks the q axis in its last
+        # dim; k blocks stream through the same lanes) — sub-128 tiles
+        # fail deep inside lowering, so reject them at the API edge.
+        # Measured failure: perf_ab pallas-b64, 2026-08-02 (chip-logs).
+        raise ValueError(
+            f"block_q/block_k must be multiples of the TPU lane width 128 "
+            f"(got {block_q}/{block_k})")
     n_pad = _padded_len(n, block_q, block_k)
     resident = _vmem_resident_bytes(n_pad, dh, q.dtype.itemsize, block_q)
     if resident > VMEM_BUDGET_BYTES and not interpret:

@@ -32,9 +32,14 @@ import jax.numpy as jnp  # noqa: E402
 VARIANTS = {
     "baseline": {},
     "pallas": dict(use_pallas=True),
-    "pallas-b64": dict(use_pallas=True, pallas_block_q=64, pallas_block_k=64),
+    # sub-128 tiles cannot lower on TPU (lane width 128 — measured failure
+    # 2026-08-02, chip-logs/ab_ptiles attempt; flash_pattern_attention now
+    # rejects them at the API edge), so the tile ladder is 128 (default) /
+    # 256 / 512
     "pallas-b256": dict(use_pallas=True, pallas_block_q=256,
                         pallas_block_k=256),
+    "pallas-b512": dict(use_pallas=True, pallas_block_q=512,
+                        pallas_block_k=512),
     "fp32": dict(dtype=jnp.float32),
     "full-attn": dict(attn_types=("full",)),
     "reversible": dict(reversible=True),
