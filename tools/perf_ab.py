@@ -55,7 +55,11 @@ VARIANTS = {
     # comparable across batch sizes (items_per_step scales with the batch).
     # Named batchN, not bN — the pallas-b64 suffix means block size.
     "batch64": dict(batch=64),
+    # plain batch128 OOMs on v5e (measured 2026-08-02: 30.3G of 15.75G
+    # HBM) — remat is the framework's own answer to that wall, so the
+    # b128 rung is measured with it on
     "batch128": dict(batch=128),
+    "batch128-remat": dict(batch=128, use_remat=True),
     # the projected production config: every lever PERF.md's analysis says
     # should stack (batch-scale the compute-starved chip + bf16 head +
     # one-hot embed backward) — A/B'd as ONE variant so interactions show
