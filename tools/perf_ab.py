@@ -70,6 +70,8 @@ VARIANTS = {
     # to 4 so the dense control fits HBM at n≈4177.
     "fmap64": dict(batch=4, image_fmap_size=64),
     "fmap64-pallas": dict(batch=4, image_fmap_size=64, use_pallas=True),
+    "fmap64-pallas-b256": dict(batch=4, image_fmap_size=64, use_pallas=True,
+                               pallas_block_q=256, pallas_block_k=256),
 }
 
 # pseudo-variants measuring other bench loops (not train-step configs).
