@@ -173,10 +173,17 @@ def run(use_pallas: bool = False, steps: int = STEPS):
     # BENCH_BATCH: record a candidate headline at a different batch without
     # editing code mid-window (the babysitter's A/B-then-measure flow).
     # The JSON meta carries the batch either way, and images/sec stays the
-    # per-image basis across batch sizes.
+    # per-image basis across batch sizes.  BENCH_PALLAS / BENCH_PALLAS_BLOCK
+    # likewise select the flash-kernel path and its tile size — the 2026-08-02
+    # tile ladder measured 512-tiles ABOVE the dense path (chip-logs/
+    # ab_ptiles.log), so the follow-up queue records a pallas headline.
     batch = int(os.environ.get("BENCH_BATCH", 16))
-    measure, cfg, batch = make_train_measure(steps, batch=batch,
-                                             use_pallas=use_pallas)
+    use_pallas = use_pallas or bool(os.environ.get("BENCH_PALLAS"))
+    overrides = dict(use_pallas=use_pallas)
+    if use_pallas and os.environ.get("BENCH_PALLAS_BLOCK"):
+        blk = int(os.environ["BENCH_PALLAS_BLOCK"])
+        overrides.update(pallas_block_q=blk, pallas_block_k=blk)
+    measure, cfg, batch = make_train_measure(steps, batch=batch, **overrides)
     images_per_sec, dt = measure()
     return images_per_sec, dt, cfg, batch
 
@@ -443,7 +450,8 @@ def main():
         "vs_baseline": None,
         "meta": {
             "steps": steps, "batch": batch, "codes_path": True,
-            "use_pallas": False,
+            "use_pallas": cfg.use_pallas,
+            **({"pallas_block": cfg.pallas_block_q} if cfg.use_pallas else {}),
             "attempt_policy": f"probe-first, best-of-{successes}, "
                               f"watchdog {_attempt_timeout():.0f}s",
         },

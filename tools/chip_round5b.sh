@@ -1,0 +1,96 @@
+#!/bin/bash
+# Round-5 follow-up chip queue: the work discovered by the 2026-08-02
+# session (chip_babysitter.sh drained its whole queue in one 45-min
+# window; these stages are the follow-ups its results created).  Same
+# probe/retry/harvest design as chip_babysitter.sh — see its header for
+# the rationale — but a separate marker namespace (r5b) so the drained
+# main queue is never re-run.
+#
+#   nohup setsid tools/chip_round5b.sh >> /tmp/chipwork5b.log 2>&1 &
+#
+# Stage order = decision value:
+#   equiv      on-chip dense-vs-pallas equivalence at n=1104/b512 (gates
+#              any default flip; VERDICT r4 next-#5's missing half)
+#   ab_flip    baseline vs pallas-b512 interleaved (the tile ladder showed
+#              232.8 vs ~217 img/s ACROSS windows; this is the same-window
+#              confirmation for flipping the production default)
+#   bench_pallas  headline bench at the pallas-b512 config -> a
+#              bench-history row under the measured-best config
+#   ab_batch2  b64 + remat'd b128 (plain b128 OOMs: 30.3G of 15.75G HBM)
+#   ab_fmap_tiles  tile ladder at the 4096-token geometry pallas already
+#              wins by 2x
+cd "$(dirname "$0")/.."
+
+QV=r5b1
+
+STAGES="equiv ab_flip bench_pallas ab_batch2 ab_fmap_tiles"
+
+CHIP_TMP=${CHIP_TMP:-/tmp}
+PROBE_SLEEP=${PROBE_SLEEP:-120}
+RETRY_SLEEP=${RETRY_SLEEP:-30}
+HARVEST_SLEEP=${HARVEST_SLEEP:-180}
+
+probe() {
+  timeout 75 python -c "import jax, jax.numpy as jnp; v=float((jnp.ones((128,128))@jnp.ones((128,128))).sum()); assert v==128.0**3" \
+    >/dev/null 2>&1
+}
+
+wait_tunnel() {
+  until probe; do echo "$(date +%T) tunnel down, sleeping ${PROBE_SLEEP}s"; sleep "$PROBE_SLEEP"; done
+  echo "$(date +%T) tunnel up"
+}
+
+run_stage() { # run_stage <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  [ -f "${CHIP_TMP}/chip_${name}.${QV}.ok" ] && { echo "$name already done"; return 0; }
+  local tries=0 rc
+  while [ $tries -lt 4 ]; do
+    wait_tunnel
+    echo "$(date +%T) starting $name (try $((tries+1))/4)"
+    timeout "$tmo" "$@" > "${CHIP_TMP}/chip_${name}.log" 2>&1
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+      echo "$(date +%T) $name DONE"; touch "${CHIP_TMP}/chip_${name}.${QV}.ok"
+      return 0
+    fi
+    echo "$(date +%T) $name failed rc=$rc"
+    tries=$((tries+1))
+    [ $tries -lt 4 ] && sleep "$RETRY_SLEEP"
+  done
+  echo "$(date +%T) $name GAVE UP"
+  return 1
+}
+
+harvest_once() {
+  mkdir -p all-logs-tpu/chip-logs
+  local name ok log dst all_done=1
+  for name in $STAGES; do
+    ok="${CHIP_TMP}/chip_${name}.${QV}.ok"; log="${CHIP_TMP}/chip_${name}.log"
+    dst="all-logs-tpu/chip-logs/${name}.log"
+    if [ -e "$ok" ]; then
+      if [ -f "$log" ] && { [ ! -f "$dst" ] || [ "$log" -nt "$dst" ]; }; then
+        cp "$log" "$dst"
+        echo "$(date +%T) harvested $name"
+      fi
+    else
+      all_done=0
+    fi
+  done
+  return $all_done
+}
+
+(
+  while true; do
+    harvest_once || exit 0
+    sleep "$HARVEST_SLEEP"
+  done
+) &
+HARVEST_PID=$!
+trap 'harvest_once; kill "$HARVEST_PID" 2>/dev/null' EXIT
+
+run_stage equiv         1500 python tools/chip_equiv.py 512
+run_stage ab_flip       1500 python tools/perf_ab.py baseline pallas-b512 --reps 3
+run_stage bench_pallas  1500 env BENCH_PALLAS=1 BENCH_PALLAS_BLOCK=512 BENCH_GEN_BATCHES= python bench.py
+run_stage ab_batch2     1800 python tools/perf_ab.py baseline batch64 batch128-remat --reps 2
+run_stage ab_fmap_tiles 1800 python tools/perf_ab.py fmap64-pallas fmap64-pallas-b256 --reps 2
+echo "$(date +%T) round-5b chip work finished"
