@@ -40,8 +40,10 @@ def _attempt_timeout() -> float:
 
 
 def _probe_enabled() -> bool:
+    from dalle_pytorch_tpu.utils.helpers import env_flag
+
     platforms = os.environ.get("JAX_PLATFORMS", "").split(",")
-    return not (os.environ.get("BENCH_SKIP_PROBE")
+    return not (env_flag("BENCH_SKIP_PROBE")
                 or platforms[0].strip() == "cpu")
 
 
@@ -177,8 +179,10 @@ def run(use_pallas: bool = False, steps: int = STEPS):
     # likewise select the flash-kernel path and its tile size — the 2026-08-02
     # tile ladder measured 512-tiles ABOVE the dense path (chip-logs/
     # ab_ptiles.log), so the follow-up queue records a pallas headline.
+    from dalle_pytorch_tpu.utils.helpers import env_flag
+
     batch = int(os.environ.get("BENCH_BATCH", 16))
-    use_pallas = use_pallas or bool(os.environ.get("BENCH_PALLAS"))
+    use_pallas = use_pallas or env_flag("BENCH_PALLAS")
     overrides = dict(use_pallas=use_pallas)
     if use_pallas and os.environ.get("BENCH_PALLAS_BLOCK"):
         blk = int(os.environ["BENCH_PALLAS_BLOCK"])
@@ -286,6 +290,84 @@ def make_gen_measure_deferred(batch: int = 8, **overrides):
         return measure
 
     return compile_fn, cfg
+
+
+def make_fused_rank_measure(batch: int = 8, num_images: int = 16,
+                            **overrides):
+    """Compile the fused generate -> VAE-decode -> CLIP-rerank pipeline
+    (genrank.rank_codes) at the CUB geometry; each ``measure()`` returns
+    ``(images_ranked_per_sec, dt)``.
+
+    The DALLE/VAE/CLIP weights are randomly initialized — the measure is
+    pipeline wall-clock (decode scan + VAE decoder + CLIP tower, chunked
+    and double-buffered, zero disk round-trips), not ranking quality.  The
+    prompt rows are identical, so the shared-prefill path is what gets
+    measured, exactly as genrank runs it.  ``overrides`` replace DALLEConfig
+    fields (e.g. ``kv_cache_bf16=False`` for the f32-cache control)."""
+    import dataclasses
+
+    import numpy as np
+
+    import genrank
+    from dalle_pytorch_tpu import DALLE, DiscreteVAE, VAEConfig
+    from dalle_pytorch_tpu.models.clip import CLIP, CLIPConfig
+
+    cfg = cub200_config()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    model = DALLE(cfg)
+    # a CUB-shaped dVAE decoder (256px, 8192 codes, fmap 32) + a ViT-B/32-
+    # shaped trained-CLIP ranker — stand-ins with the production geometry
+    vae = DiscreteVAE(VAEConfig(
+        image_size=cfg.image_size, num_tokens=cfg.num_image_tokens,
+        codebook_dim=256, num_layers=3, num_resnet_blocks=1, hidden_dim=64))
+    clip_cfg = CLIPConfig(
+        dim_text=256, dim_image=256, dim_latent=256,
+        num_text_tokens=cfg.num_text_tokens, text_enc_depth=4,
+        text_seq_len=cfg.text_seq_len, text_heads=8, num_visual_tokens=512,
+        visual_enc_depth=6, visual_heads=8, visual_image_size=224,
+        visual_patch_size=32)
+    clip = CLIP(clip_cfg)
+
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (1, cfg.text_seq_len), 0,
+                                cfg.num_text_tokens)
+    text = np.repeat(np.asarray(prompt), num_images, axis=0)
+    params = jax.jit(lambda r: model.init(
+        r, prompt, jnp.zeros((1, cfg.image_seq_len), jnp.int32))["params"])(rng)
+    vae_params = jax.jit(lambda r: vae.init(
+        {"params": r, "gumbel": r},
+        jnp.zeros((1, cfg.image_size, cfg.image_size, 3)))["params"])(rng)
+    clip_params = jax.jit(lambda r: clip.init(
+        r, prompt, jnp.zeros((1, 224, 224, 3)))["params"])(rng)
+
+    decode = jax.jit(lambda codes: vae.apply(
+        {"params": vae_params}, codes, method=DiscreteVAE.decode))
+
+    @jax.jit
+    def score(ims):
+        text_lat = clip.apply({"params": clip_params}, prompt,
+                              method=CLIP.encode_text)
+        img_lat = clip.apply({"params": clip_params},
+                             genrank._preprocess(ims, 224),
+                             method=CLIP.encode_image)
+        temp = jnp.exp(clip_params["temperature"])
+        return ((text_lat @ img_lat.T) * temp)[0]
+
+    def run_once(key):
+        return genrank.rank_codes(model, params, decode, score, text,
+                                  batch_size=batch, top_k=0.9, rng=key)
+
+    run_once(jax.random.PRNGKey(1))  # compile + warm
+
+    def measure():
+        t0 = time.perf_counter()
+        _, logits = run_once(jax.random.PRNGKey(2))
+        dt = time.perf_counter() - t0  # rank_codes returns host arrays: synced
+        assert np.isfinite(logits).all(), "non-finite fused-rank logits"
+        return num_images / dt, dt
+
+    return measure
 
 
 def _bounded_call(fn):
@@ -548,7 +630,9 @@ def main():
                     "value": round(gen_result[0], 1),
                     "unit": "image_tokens/sec",
                     "meta": {"batch": gen_batch, "image_only_head": True}})
-    if os.environ.get("BENCH_VAE"):  # opt-in stage-1 number (BASELINE cfg 1)
+    from dalle_pytorch_tpu.utils.helpers import env_flag
+
+    if env_flag("BENCH_VAE"):  # opt-in stage-1 number (BASELINE cfg 1)
         vae_result = bounded_stage(
             "vae", lambda: make_vae_measure()(),
             lambda r: f"vae train (128px): {r[0]:.2f} images/sec")

@@ -17,7 +17,8 @@ import numpy as np
 
 from . import DALLE, DALLEConfig, DiscreteVAE, VAEConfig
 from .data.tokenizer import ChineseTokenizer, HugTokenizer, SimpleTokenizer
-from .models.dalle import generate_codes
+from .models.dalle import (decode_codes, generate_codes, prefill_codes,
+                           tile_prefill)
 from .utils.checkpoint import (load_checkpoint, migrate_head_kernels,
                                migrate_qkv_kernels)
 
@@ -147,33 +148,89 @@ def make_decode_fn(vae, vae_params):
     return decode
 
 
+def iter_generated_chunks(dalle, params, text_tokens: np.ndarray, *,
+                          batch_size: int, top_k: float, rng,
+                          temperature: float = 1.0,
+                          top_p: Optional[float] = None):
+    """Sample image codes for [n, text_seq_len] tokens in ``batch_size``
+    chunks.  Returns ``(chunks, rng)`` where ``chunks`` yields
+    ``(codes [batch_size, image_seq_len] device array, n_valid)`` — codes
+    stay on device so downstream consumers (the VAE decode, genrank's fused
+    CLIP scorer) can keep the whole pipeline as device arrays.
+
+    **Shared prompt prefill**: when every row is the same prompt (the
+    generate/genrank candidate fan-out builds tokens as
+    ``np.repeat(prompt, num_images)``), the prompt is prefilled ONCE at
+    batch 1 and the resulting KV caches broadcast across the candidate
+    batch (``models.dalle.tile_prefill``) — exact, because the prompt
+    positions' k/v never depend on the sampled continuation.  Each chunk
+    then pays only the decode scan instead of decode + a redundant
+    full-sequence prefill forward.  Requests with distinct prompts (the
+    pickled-captions eval mode) keep the per-chunk ``generate_codes``
+    path, padding the last chunk to hold one compiled shape.
+    """
+    n = text_tokens.shape[0]
+    if n == 0:
+        return iter(()), rng
+    # one short request compiles at its natural size; padding only pays for
+    # itself when it saves a recompile across multiple chunks
+    batch_size = min(batch_size, n)
+    n_chunks = -(-n // batch_size)
+    keys = jax.random.split(rng, n_chunks + 1)
+    rng_out, keys = keys[0], keys[1:]
+    shared = bool(np.all(np.asarray(text_tokens) == text_tokens[:1]))
+
+    if shared:
+        decode_fn = jax.jit(lambda p, fl, c, k: decode_codes(
+            dalle, p, fl, c, k, filter_thres=top_k, temperature=temperature,
+            top_p=top_p))
+
+        def gen_shared():
+            first1, caches1 = jax.jit(
+                lambda p, t: prefill_codes(dalle, p, t))(
+                    {'params': params},
+                    jnp.asarray(text_tokens[:1], jnp.int32))
+            first, caches = tile_prefill(first1, caches1, batch_size)
+            for i in range(n_chunks):
+                codes = decode_fn({'params': params}, first, caches, keys[i])
+                yield codes, min(batch_size, n - i * batch_size)
+
+        return gen_shared(), rng_out
+
+    def gen_distinct():
+        for i in range(n_chunks):
+            chunk = text_tokens[i * batch_size: (i + 1) * batch_size]
+            pad = batch_size - chunk.shape[0]
+            if pad:
+                chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, 0)])
+            codes = generate_codes(dalle, {'params': params},
+                                   jnp.asarray(chunk, jnp.int32), keys[i],
+                                   filter_thres=top_k,
+                                   temperature=temperature, top_p=top_p)
+            yield codes, batch_size - pad
+
+    return gen_distinct(), rng_out
+
+
 def generate_chunked(dalle, params, decode, text_tokens: np.ndarray, *,
                      batch_size: int, top_k: float, rng,
                      temperature: float = 1.0, top_p: Optional[float] = None,
                      desc: str = 'generating'):
-    """Generate images for [n, text_seq_len] tokens in `batch_size` chunks.
-
-    Pads the last chunk (keeping one compiled shape) and drops the padding
-    rows from the output.  Returns (images [n, h, w, 3], rng).
+    """Generate images for [n, text_seq_len] tokens in `batch_size` chunks
+    (`iter_generated_chunks` semantics: one shared prompt prefill when all
+    rows are identical).  Returns (images [n, h, w, 3], rng).
     """
     outs = []
     n = text_tokens.shape[0]
-    # one short request compiles at its natural size; padding only pays for
-    # itself when it saves a recompile across multiple chunks
-    batch_size = min(batch_size, n) if n else batch_size
-    for s in range(0, n, batch_size):
-        chunk = text_tokens[s: s + batch_size]
-        pad = batch_size - chunk.shape[0]
-        if pad:
-            chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, 0)])
-        rng, key = jax.random.split(rng)
-        codes = generate_codes(dalle, {'params': params},
-                               jnp.asarray(chunk, jnp.int32), key,
-                               filter_thres=top_k, temperature=temperature,
-                               top_p=top_p)
+    chunks, rng = iter_generated_chunks(
+        dalle, params, text_tokens, batch_size=batch_size, top_k=top_k,
+        rng=rng, temperature=temperature, top_p=top_p)
+    done = 0
+    for codes, n_valid in chunks:
         images = np.asarray(jax.device_get(decode(codes)))
-        outs.append(images[: batch_size - pad] if pad else images)
-        print(f'{desc}: {min(s + batch_size, n)}/{n}', flush=True)
+        outs.append(images[:n_valid])
+        done += n_valid
+        print(f'{desc}: {done}/{n}', flush=True)
     return (np.concatenate(outs) if outs else np.zeros((0,))), rng
 
 

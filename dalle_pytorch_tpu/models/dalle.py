@@ -97,13 +97,23 @@ class DALLEConfig:
     # True gathers only the reachable keys per step, False streams the full
     # cache — the measured A/B control (tools/perf_ab.py `gen-dense`).
     sliced_kv_decode: bool = True
+    # Decode-time KV-cache STORAGE dtype: True keeps the caches in bf16 even
+    # when activations are f32 (checkpoint-loaded eval models default to
+    # f32).  The decode loop is measured HBM-bound on cache traffic
+    # (PERF.md: sliced-KV 2.16x), so halving every cache byte is a direct
+    # cut to its dominant stream; attention still *accumulates* in f32
+    # (ops/attention.py::decode_step computes all q·k dots with
+    # preferred_element_type=f32 and softmaxes in f32), so only the stored
+    # k/v values round through bf16.  False is the A/B control
+    # (tools/perf_ab.py `gen_f32cache`).  No-op when dtype is already bf16.
+    kv_cache_bf16: bool = True
     dtype: Any = jnp.float32
 
     # execution-plan fields stripped from checkpoint hparams (like dtype):
     # they select how the same params are computed, not what the model is
     _PLAN_FIELDS = ("ring_axis", "sp_impl", "sp_size",
                     "ff_expert_dispatch", "ff_expert_capacity_factor",
-                    "head_phase_sliced", "sliced_kv_decode")
+                    "head_phase_sliced", "sliced_kv_decode", "kv_cache_bf16")
 
     @property
     def image_seq_len(self) -> int:
@@ -507,6 +517,12 @@ class DALLE(nn.Module):
 
         out, kvs = self.transformer(tokens, mask=self._pad_mask_for_bos(mask),
                                     return_kv=True)
+        if cfg.kv_cache_bf16:
+            # cache STORAGE dtype only: the decode step re-reads these
+            # through f32-accumulating dots (ops/attention.py::decode_step),
+            # so this is a pure byte cut on the HBM-bound decode loop
+            kvs = [(k.astype(jnp.bfloat16), v.astype(jnp.bfloat16))
+                   for k, v in kvs]
         last = out[:, n_pre - 1 : n_pre]
         logits = self._head(last, image_only=True)
         return logits[:, 0], kvs
@@ -530,24 +546,49 @@ class DALLE(nn.Module):
         return logits[:, 0], caches
 
 
-def generate_codes(dalle: DALLE, params, text, rng, *, prime_codes=None,
-                   filter_thres: float = 0.5, temperature: float = 1.0,
-                   top_p: Optional[float] = None, mask=None) -> jax.Array:
-    """Sample a full image token sequence [b, image_seq_len].
+def prefill_codes(dalle: DALLE, params, text, *, prime_codes=None,
+                  mask=None):
+    """The prompt half of the sampler: run the full forward over
+    [bos+text (+prime)] once, returning ``(first_logits [b, num_image_
+    tokens], caches)`` — the state ``decode_codes`` continues from.
 
-    Pure jittable function: prefill once, then a `lax.scan` KV-cache decode.
-    Sampling semantics match the reference exactly (top_k filter with
+    Split out of ``generate_codes`` so callers sampling MANY candidates of
+    the SAME prompt (cli.generate_chunked, genrank) can pay this forward
+    once per unique prompt and ``tile_prefill`` the result across the
+    candidate batch, instead of re-running the prefill transformer for
+    every batch-size chunk."""
+    return dalle.apply(params, text, prime_codes, mask, method=DALLE.prefill)
+
+
+def tile_prefill(first_logits, caches, reps: int):
+    """Broadcast a batch-1 prefill state across ``reps`` candidates.
+
+    Every candidate of one prompt shares an identical prefill (the prompt
+    positions' k/v never depend on the sampled continuation), so tiling the
+    cached state is exact — one HBM write of the caches instead of ``reps``
+    prefill forwards.  The per-candidate divergence comes entirely from the
+    decode loop's rng."""
+    assert first_logits.shape[0] == 1, (
+        "tile_prefill broadcasts a single-prompt (batch-1) prefill; got "
+        f"batch {first_logits.shape[0]}")
+    rep = lambda a: jnp.repeat(a, reps, axis=0)  # noqa: E731
+    return rep(first_logits), [(rep(k), rep(v)) for k, v in caches]
+
+
+def decode_codes(dalle: DALLE, params, first_logits, caches, rng, *,
+                 n_prime: int = 0, prime_codes=None,
+                 filter_thres: float = 0.5, temperature: float = 1.0,
+                 top_p: Optional[float] = None, mask=None) -> jax.Array:
+    """The sampling half: `lax.scan` KV-cache decode from a prefill state
+    (``prefill_codes`` or a ``tile_prefill`` broadcast of one).  Sampling
+    semantics match the reference exactly (top_k filter with
     ``k = max(int((1-thres)*vocab), 1)``, temperature softmax, categorical
     draw, image-vocab offset subtraction; ref dalle_pytorch.py:400-415).
     ``top_p`` additionally applies nucleus filtering after top-k (a knob
     the reference lacks).
     """
     cfg = dalle.cfg
-    n_prime = 0 if prime_codes is None else prime_codes.shape[1]
     n_pre = cfg.text_seq_len + 1 + n_prime
-
-    first_logits, caches = dalle.apply(
-        params, text, prime_codes, mask, method=DALLE.prefill)
 
     def sample(logits, key):
         # logits are image-vocab-only; k still derives from the full joint
@@ -586,3 +627,21 @@ def generate_codes(dalle: DALLE, params, text, rng, *, prime_codes=None,
     if prime_codes is not None and n_prime > 0:
         parts.insert(0, prime_codes)
     return jnp.concatenate(parts, axis=1)
+
+
+def generate_codes(dalle: DALLE, params, text, rng, *, prime_codes=None,
+                   filter_thres: float = 0.5, temperature: float = 1.0,
+                   top_p: Optional[float] = None, mask=None) -> jax.Array:
+    """Sample a full image token sequence [b, image_seq_len].
+
+    Pure jittable function: ``prefill_codes`` once, then the
+    ``decode_codes`` scan — the one-shot composition of the split halves
+    (callers amortizing one prompt across many candidates use the halves
+    directly; see ``tile_prefill``)."""
+    n_prime = 0 if prime_codes is None else prime_codes.shape[1]
+    first_logits, caches = prefill_codes(dalle, params, text,
+                                         prime_codes=prime_codes, mask=mask)
+    return decode_codes(dalle, params, first_logits, caches, rng,
+                        n_prime=n_prime, prime_codes=prime_codes,
+                        filter_thres=filter_thres, temperature=temperature,
+                        top_p=top_p, mask=mask)

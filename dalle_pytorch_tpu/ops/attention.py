@@ -461,7 +461,8 @@ class MultiHeadAttention(nn.Module):
                 safe = jnp.clip(positions, 0, n_k - 1)
                 k_sub = jnp.take(cache_k, safe, axis=2)  # [b, h, m, dh]
                 v_sub = jnp.take(cache_v, safe, axis=2)
-            dots = jnp.einsum("bhid,bhjd->bhij", q * scale, k_sub,
+            dots = jnp.einsum("bhid,bhjd->bhij",
+                              (q * scale).astype(cache_k.dtype), k_sub,
                               preferred_element_type=jnp.float32)
             row = (_allowed(self.pattern, index, positions, jnp)
                    & valid)[None, None, None, :]
@@ -469,12 +470,13 @@ class MultiHeadAttention(nn.Module):
                 pad = _scope_key_pad(self.pattern, mask, n_k)
                 row = row & jnp.take(pad, safe, axis=1)[:, None, None, :]
             dots = jnp.where(row, dots, max_neg_value(dots.dtype))
-            attn = jax.nn.softmax(dots, axis=-1).astype(x.dtype)
-            out = jnp.einsum("bhij,bhjd->bhid", attn, v_sub.astype(x.dtype))
+            attn = jax.nn.softmax(dots, axis=-1)  # f32
+            out = self._attn_v(attn, v_sub, x.dtype)
             out = out.transpose(0, 2, 1, 3).reshape(
                 b, 1, self.heads * self.dim_head)
             return self.to_out(out), cache_k, cache_v
-        dots = jnp.einsum("bhid,bhjd->bhij", q * scale, cache_k,
+        dots = jnp.einsum("bhid,bhjd->bhij",
+                          (q * scale).astype(cache_k.dtype), cache_k,
                           preferred_element_type=jnp.float32)
         layout = self.pattern.block_layout()
         row = pattern_mask_row(
@@ -483,7 +485,27 @@ class MultiHeadAttention(nn.Module):
         )[None, None, None, :]
         row = _merge_key_pad_mask(self.pattern, row, mask)
         dots = jnp.where(row, dots, max_neg_value(dots.dtype))
-        attn = jax.nn.softmax(dots, axis=-1).astype(x.dtype)
-        out = jnp.einsum("bhij,bhjd->bhid", attn, cache_v.astype(x.dtype))
+        attn = jax.nn.softmax(dots, axis=-1)  # f32
+        out = self._attn_v(attn, cache_v, x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(b, 1, self.heads * self.dim_head)
         return self.to_out(out), cache_k, cache_v
+
+    @staticmethod
+    def _attn_v(attn, v, out_dtype):
+        """Decode-step attn (f32) x cached-v contraction.
+
+        When the cache dtype differs from the activation dtype (the
+        kv_cache_bf16 case: f32 activations, bf16 storage) the
+        multiplicands stay in the CACHE dtype with f32 ACCUMULATION
+        (preferred_element_type) — the MXU's native bf16-in/f32-acc mode.
+        Upcasting v to the activation dtype instead would let XLA hoist
+        the convert through the cache update and materialize a full f32
+        copy of the bf16 cache (measured: it more than doubles the decode
+        step's cache bytes, defeating DALLEConfig.kv_cache_bf16 entirely).
+        When the dtypes already match, the contraction keeps the exact
+        form the decode-byte gates are calibrated against."""
+        if v.dtype == out_dtype:
+            return jnp.einsum("bhij,bhjd->bhid", attn.astype(out_dtype), v)
+        return jnp.einsum("bhij,bhjd->bhid", attn.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32
+                          ).astype(out_dtype)

@@ -214,7 +214,10 @@ def _bwd_dkv_kernel(bsum_ref, q_ref, k_ref, v_ref, mask_ref, bias_ref,
 # only inside the per-program fori_loop), so both grid axes are parallel —
 # this lets Mosaic pipeline/reorder programs freely (megacore splits on
 # v4/v5p; no-op on single-tensorcore chips).
-_PARALLEL_GRID = pltpu.CompilerParams(
+# CompilerParams was TPUCompilerParams before jax 0.5.x — accept either so
+# the module imports across the jax versions CI and the chip box run
+_PARALLEL_GRID = getattr(pltpu, "CompilerParams",
+                         getattr(pltpu, "TPUCompilerParams", None))(
     dimension_semantics=("parallel", "parallel"))
 
 

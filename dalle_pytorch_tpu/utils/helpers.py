@@ -12,6 +12,24 @@ import jax
 import jax.numpy as jnp
 
 
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean environment flag with OFF-able semantics: unset -> default;
+    ``"0"``, ``"false"``, ``"no"``, ``"off"`` and the empty string (any
+    case) -> False; anything else -> True.
+
+    ``bool(os.environ.get(X))`` treats ``X=0`` as ON — an operator
+    disabling a flag with 0 would silently enable it (the BENCH_PALLAS /
+    GRAFT_DRYRUN_FULL footgun, ADVICE.md round 5).  All boolean env knobs
+    parse through here.
+    """
+    import os
+
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() not in ("", "0", "false", "no", "off")
+
+
 def exists(val):
     return val is not None
 

@@ -89,6 +89,23 @@ def dalle_train_flops(cfg, batch: int) -> float:
         vocab=cfg.total_tokens, batch=batch, logits_flops=logits_fwd)
 
 
+def dalle_decode_cache_bytes(cfg, batch: int) -> int:
+    """Bytes of KV-cache state one decode step carries (each of depth x
+    (k, v) caches at [batch, heads, seq_len, dim_head]) — the decode
+    loop's dominant HBM stream (PERF.md: the loop is measured
+    bandwidth-bound on cache reads, sliced-KV 2.16x).  The storage dtype
+    follows ``cfg.kv_cache_bf16`` (bf16 even at f32 activations; the
+    knob's whole point) or the activation dtype when that is already
+    half-width.  ``tests/test_perf_model.py`` pins the compiled decode
+    step's cache I/O against this number."""
+    import jax.numpy as jnp
+
+    half = cfg.kv_cache_bf16 or jnp.dtype(cfg.dtype).itemsize == 2
+    itemsize = 2 if half else 4
+    return (cfg.depth * 2 * batch * cfg.heads * cfg.seq_len * cfg.dim_head
+            * itemsize)
+
+
 def compiled_cost_summary(fn, *args, donate_argnums=(),
                           static_argnums=()) -> dict:
     """Compile ``fn(*args)`` (no execution, no device memory) and return

@@ -17,7 +17,12 @@ Capability parity with `/root/reference/generate.py`:
 TPU-native: generation is the jitted prefill + lax.scan KV-cache sampler
 (`dalle_pytorch_tpu.models.dalle.generate_codes`) — output-equivalent to the
 reference's full-forward-per-token loop but O(n) per token, compiled once
-per batch shape.
+per batch shape.  Prompt mode prefills each prompt ONCE and tiles the
+resulting KV caches across the candidate batch (`cli.iter_generated_chunks`
+shared-prefill path), so every `batch_size` chunk pays only the decode
+scan; the caches are stored bf16 by default (`DALLEConfig.kv_cache_bf16` —
+checkpoint-loaded models run f32 activations, and the decode loop is
+HBM-bound on cache bytes).
 """
 from __future__ import annotations
 

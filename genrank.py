@@ -14,8 +14,19 @@ TPU-native: the ranker is this framework's own JAX ``CLIP`` model (see
 ``dalle_pytorch_tpu/models/clip.py``) loaded from ``--clip_path`` — either a
 CLIP trained with ``train_clip``-style steps or converted ViT-B/32 weights.
 The reference instead downloads OpenAI's torch CLIP, which needs network
-egress.  Without ``--clip_path`` the harness still generates + saves + grids
-the images and records unranked order.
+egress.  Without ``--clip_path`` the harness still generates + grids the
+images and records unranked order.
+
+The DEFAULT path is fused and on-device (``rank_codes``): sampled codes
+feed straight into the VAE decoder and the CLIP scorer as device arrays,
+chunked and double-buffered — chunk *i*'s images/scores are fetched only
+after chunk *i+1*'s sampling has been dispatched — with the prompt
+prefilled once and its KV caches tiled across the candidate batch
+(``cli.iter_generated_chunks``).  No intermediate image files touch disk;
+only the final ranking grid + logits ``.npy`` are written.  ``--save_all``
+restores the reference's artifact behavior (save every candidate JPEG,
+re-read the files, rank the re-read pixels — ref :54-59's deliberate disk
+round-trip, including its JPEG quantization).
 """
 from __future__ import annotations
 
@@ -53,6 +64,11 @@ def parse_args(argv=None):
                         help='CLIP merges txt (bpe_simple_vocab_16e6.txt), '
                              'required with a converted OpenAI CLIP ranker')
     parser.add_argument('--taming', action='store_true')
+    parser.add_argument('--save_all', action='store_true',
+                        help='save every candidate JPEG and rank the re-read '
+                             'files (the reference\'s disk round-trip, incl. '
+                             'JPEG quantization); the default ranks fused '
+                             'on-device with no intermediate image files')
     return parser.parse_args(argv)
 
 
@@ -137,21 +153,26 @@ def clip_ranking(clip_model, clip_params, tokenizer, images, caption):
     return _softmax(logits), logits
 
 
-def clip_vit_ranking(clip_model, clip_params, images, caption,
-                     clip_bpe_path):
-    """Ranking with the converted official OpenAI CLIP ViT
-    (models/clip_vit.py + tools/convert_weights.py clip) — the reference's
-    actual ranker (genrank.py:20-22).  Text goes through the CLIP BPE with
+def _clip_vit_text_ids(cfg, caption, clip_bpe_path):
+    """Caption -> [1, context_length] CLIP BPE ids with
     <|startoftext|>/<|endoftext|> wrapping, as `clip.tokenize` does."""
     from dalle_pytorch_tpu.data.tokenizer import SimpleTokenizer
 
-    cfg = clip_model.cfg
     tok = SimpleTokenizer(clip_bpe_path)
     ids = [tok.encoder[tok.SOT]] + tok.encode(caption)[: cfg.context_length - 2]
     ids.append(tok.encoder[tok.EOT])
     text = np.zeros((1, cfg.context_length), np.int32)
     text[0, : len(ids)] = ids
+    return text
 
+
+def clip_vit_ranking(clip_model, clip_params, images, caption,
+                     clip_bpe_path):
+    """Ranking with the converted official OpenAI CLIP ViT
+    (models/clip_vit.py + tools/convert_weights.py clip) — the reference's
+    actual ranker (genrank.py:20-22)."""
+    cfg = clip_model.cfg
+    text = _clip_vit_text_ids(cfg, caption, clip_bpe_path)
     ims = _preprocess(images, cfg.image_size)
 
     @jax.jit
@@ -162,6 +183,109 @@ def clip_vit_ranking(clip_model, clip_params, images, caption,
     logits = np.asarray(jax.device_get(
         score(clip_params, jnp.asarray(text), ims)))[0]
     return _softmax(logits), logits
+
+
+def make_clip_scorer(clip_path, tokenizer, caption, clip_bpe_path=None):
+    """Build the device-side half of the fused pipeline: a jitted
+    ``images [b, h, w, 3] (floats in [0, 1], host or device) ->
+    logits_per_text [b]`` scorer from a ranker checkpoint.  The caption is
+    tokenized once at build time; per chunk only the image tower +
+    similarity run.  Handles both ranker kinds (a trained ``models.clip
+    .CLIP``, or a converted official OpenAI ``CLIPViT``, selected by the
+    checkpoint hparams exactly as ``get_model_output`` always has).
+    Returns None when ``clip_path`` is None (unranked mode)."""
+    if clip_path is None:
+        return None
+    from dalle_pytorch_tpu.utils.checkpoint import migrate_qkv_kernels
+
+    ckpt = load_checkpoint(clip_path)
+    hparams = dict(ckpt['hparams'])
+    clip_params = jax.tree.map(
+        jnp.asarray, migrate_qkv_kernels(ckpt['weights']))
+    if 'vision_width' in hparams:
+        from dalle_pytorch_tpu.models.clip_vit import CLIPViT, CLIPViTConfig
+
+        model = CLIPViT(CLIPViTConfig.from_dict(hparams))
+        if clip_bpe_path is None:
+            raise SystemExit(
+                '--clip_bpe_path (the CLIP merges txt) is required with '
+                'a converted OpenAI CLIP ranker')
+        text = jnp.asarray(_clip_vit_text_ids(model.cfg, caption,
+                                              clip_bpe_path))
+        size = model.cfg.image_size
+
+        @jax.jit
+        def score(ims):
+            logits_per_text, _ = model.apply(
+                {'params': clip_params}, text, _preprocess(ims, size))
+            return logits_per_text[0]
+    else:
+        model = CLIP(CLIPConfig.from_dict(hparams))
+        text = jnp.asarray(
+            tokenizer.tokenize([caption], model.cfg.text_seq_len,
+                               truncate_text=True), jnp.int32)
+        size = model.cfg.visual_image_size
+
+        @jax.jit
+        def score(ims):
+            text_lat = model.apply({'params': clip_params}, text,
+                                   method=CLIP.encode_text)
+            img_lat = model.apply({'params': clip_params},
+                                  _preprocess(ims, size),
+                                  method=CLIP.encode_image)
+            temp = jnp.exp(clip_params['temperature'])
+            return ((text_lat @ img_lat.T) * temp)[0]
+
+    return score
+
+
+def rank_codes(dalle, params, decode, score_fn, text_tokens, *,
+               batch_size=BATCH_SIZE, top_k=TOP_K, rng=None):
+    """Fused on-device generate -> VAE-decode -> CLIP-rerank.
+
+    Samples image codes chunk-wise (shared prompt prefill:
+    ``cli.iter_generated_chunks`` prefills the repeated prompt once and
+    tiles its KV caches over the candidate batch) and feeds each chunk's
+    codes straight into the jitted VAE ``decode`` and the ``score_fn``
+    scorer as device arrays — no JPEG disk round-trip, no host transfer of
+    intermediates.  Double-buffered: chunk *i*'s images/scores are fetched
+    to host only AFTER chunk *i+1*'s sampling has been dispatched, so with
+    JAX's async dispatch the host-side fetch of chunk *i* overlaps chunk
+    *i+1*'s device work (on one device the compute itself serializes; the
+    win is that the device never idles on host fetches and nothing round-
+    trips through image files).
+
+    ``score_fn`` None records unranked order (zero logits), matching the
+    no-``--clip_path`` harness behavior.  Returns host numpy
+    ``(images [n, h, w, 3], logits [n])``.
+    """
+    from dalle_pytorch_tpu.cli import iter_generated_chunks
+
+    n = text_tokens.shape[0]
+    chunks, _ = iter_generated_chunks(
+        dalle, params, text_tokens, batch_size=batch_size, top_k=top_k,
+        rng=jax.random.PRNGKey(0) if rng is None else rng)
+    ims_out, logits_out = [], []
+
+    def drain(entry):
+        images, scores, n_valid = entry
+        ims_out.append(np.asarray(jax.device_get(images))[:n_valid])
+        logits_out.append(
+            np.zeros((n_valid,), np.float32) if scores is None
+            else np.asarray(jax.device_get(scores), np.float32)[:n_valid])
+
+    prev = None
+    for codes, n_valid in chunks:
+        images = decode(codes)
+        scores = score_fn(images) if score_fn is not None else None
+        if prev is not None:
+            drain(prev)
+        prev = (images, scores, n_valid)
+    if prev is not None:
+        drain(prev)
+    if not ims_out:
+        return np.zeros((0,)), np.zeros((0,), np.float32)
+    return np.concatenate(ims_out)[:n], np.concatenate(logits_out)[:n]
 
 
 def show_reranking(images, scores, logits, sort=True, cols_wide=4):
@@ -191,8 +315,40 @@ def show_reranking(images, scores, logits, sort=True, cols_wide=4):
     return figs
 
 
+def get_model_output_fused(dalle_path, text, num_images, bpe_path,
+                           clip_path, taming, clip_bpe_path=None):
+    """The default (fused, on-device) harness: rank_codes end-to-end, zero
+    intermediate image files.  The ranked pixels are the VAE decoder's own
+    output — the ``--save_all`` path instead ranks pixels that round-
+    tripped through JPEG files, so its logits differ by the quantization
+    the reference deliberately kept (ref :54-59)."""
+    from dalle_pytorch_tpu.cli import (load_dalle_checkpoint, make_decode_fn,
+                                       select_tokenizer)
+
+    tokenizer = select_tokenizer(bpe_path)
+    score_fn = make_clip_scorer(clip_path, tokenizer, text,
+                                clip_bpe_path=clip_bpe_path)
+    dalle, cfg, params, vae, vae_params = load_dalle_checkpoint(
+        dalle_path, taming=taming)
+    decode = make_decode_fn(vae, vae_params)
+    tokens = tokenizer.tokenize([text], cfg.text_seq_len, truncate_text=True)
+    tokens = np.repeat(tokens, num_images, axis=0)
+    images, logits = rank_codes(dalle, params, decode, score_fn, tokens,
+                                batch_size=BATCH_SIZE, top_k=TOP_K,
+                                rng=jax.random.PRNGKey(0))
+    if score_fn is None:
+        print('no --clip_path: skipping CLIP ranking, recording unranked order')
+        probs = np.full((num_images,), 1.0 / num_images, np.float32)
+    else:
+        probs = _softmax(logits)
+    figs = show_reranking(images, probs, logits)
+    return figs, probs, logits
+
+
 def get_model_output(dalle_path, out_path, text, num_images, bpe_path,
                      clip_path, taming, clip_bpe_path=None):
+    """The legacy file-based harness (``--save_all``): generate, save every
+    candidate JPEG, re-read the files, rank the re-read pixels."""
     ims, tokenizer = generate_images(dalle_path, text, num_images, BATCH_SIZE,
                                      TOP_K, bpe_path, taming)
     folder = f'{out_path}/{Path(dalle_path).name[:-3]}'
@@ -240,10 +396,15 @@ def main(argv=None):
     # model name parsed from the ckpt filename (ref :160-161)
     mname = Path(args.dalle_path).name.replace('.pt', '')
 
-    figs, probs, logits = get_model_output(
-        args.dalle_path, args.out_path, args.text, args.num_images,
-        args.bpe_path, args.clip_path, args.taming,
-        clip_bpe_path=args.clip_bpe_path)
+    if args.save_all:
+        figs, probs, logits = get_model_output(
+            args.dalle_path, args.out_path, args.text, args.num_images,
+            args.bpe_path, args.clip_path, args.taming,
+            clip_bpe_path=args.clip_bpe_path)
+    else:
+        figs, probs, logits = get_model_output_fused(
+            args.dalle_path, args.text, args.num_images, args.bpe_path,
+            args.clip_path, args.taming, clip_bpe_path=args.clip_bpe_path)
 
     fname = out_path / f'B{mname}'
     np.save(fname, logits)

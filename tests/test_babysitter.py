@@ -25,7 +25,7 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-N_STAGES = 12  # keep in sync with STAGES in tools/chip_babysitter.sh
+N_STAGES = 14  # keep in sync with STAGES in tools/chip_babysitter.sh
 
 
 def script_qv() -> int:
@@ -88,8 +88,10 @@ def test_full_queue_runs_marks_and_harvests(tmp_path):
     harvested = sorted(p.name for p in
                        (repo / "all-logs-tpu" / "chip-logs").glob("*.log"))
     assert len(harvested) == N_STAGES, harvested
-    # value-ordering: the candidate A/B must be the FIRST stage to run
-    assert out.index("starting ab_cand") < out.index("starting bench ")
+    # value-ordering: the bf16-KV-cache decode A/B leads the queue, then
+    # the fused-rerank pipeline, then the candidate A/B, then bench
+    assert (out.index("starting gen_bf16_ab") < out.index("starting gen_fused_ab")
+            < out.index("starting ab_cand") < out.index("starting bench "))
     # the harvest loop must not outlive the script (r3 ADVICE leak): no
     # process still has our sandbox in its command line.  The EXIT trap's
     # kill is asynchronous, so poll briefly instead of one snapshot (the

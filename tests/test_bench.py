@@ -324,6 +324,14 @@ def test_perf_ab_tool(monkeypatch, capsys):
     assert perf_ab.main(["gen-dense", "--reps", "1"]) == 0
     assert seen_gen_calls == [(8, {"sliced_kv_decode": False})]
 
+    # the bf16-KV-cache A/B pair rides the traced config the same way:
+    # f32 activations (the eval dtype) with the cache knob on vs off
+    seen_gen_calls.clear()
+    assert perf_ab.main(["gen_bf16", "gen_f32cache", "--reps", "1"]) == 0
+    assert seen_gen_calls == [
+        (8, {"dtype": jnp.float32, "kv_cache_bf16": True}),
+        (8, {"dtype": jnp.float32, "kv_cache_bf16": False})]
+
 
 def test_perf_ab_rejects_bad_args(monkeypatch, capsys):
     from pathlib import Path
@@ -338,6 +346,65 @@ def test_perf_ab_rejects_bad_args(monkeypatch, capsys):
         perf_ab.main(["baseline", "--reps", "0"])
     with pytest.raises(SystemExit):  # repeated names would silently collapse
         perf_ab.main(["baseline", "baseline"])
+
+
+def test_env_flag_semantics(monkeypatch):
+    """Boolean env knobs must be OFF-able: X=0/false/no/off (any case)
+    parse as False; bool(os.environ.get(X)) treated '0' as ON (the
+    BENCH_PALLAS / GRAFT_DRYRUN_FULL footgun, ADVICE.md round 5)."""
+    from dalle_pytorch_tpu.utils.helpers import env_flag
+
+    monkeypatch.delenv("X_FLAG", raising=False)
+    assert env_flag("X_FLAG") is False
+    assert env_flag("X_FLAG", default=True) is True
+    for off in ("0", "false", "no", "off", "", "False", " 0 ", "OFF"):
+        monkeypatch.setenv("X_FLAG", off)
+        assert env_flag("X_FLAG") is False, repr(off)
+        assert env_flag("X_FLAG", default=True) is False, repr(off)
+    for on in ("1", "true", "yes", "512", "on"):
+        monkeypatch.setenv("X_FLAG", on)
+        assert env_flag("X_FLAG") is True, repr(on)
+
+
+def test_bench_pallas_env_zero_is_off(monkeypatch):
+    """BENCH_PALLAS=0 must benchmark the baseline (non-pallas) config —
+    an operator disabling the flag with 0 used to silently flip the
+    headline bench onto the pallas path."""
+    monkeypatch.setenv("BENCH_PALLAS", "0")
+    seen = {}
+
+    def fake_mtm(steps, batch=16, **overrides):
+        seen.update(overrides)
+        return (lambda: (1.0, 1.0)), bench.cub200_config(), batch
+
+    monkeypatch.setattr(bench, "make_train_measure", fake_mtm)
+    bench.run(steps=1)
+    assert seen.get("use_pallas") is False
+
+    seen.clear()
+    monkeypatch.setenv("BENCH_PALLAS", "1")
+    bench.run(steps=1)
+    assert seen.get("use_pallas") is True
+
+
+@pytest.mark.slow
+def test_fused_rank_measure_tiny(monkeypatch):
+    """make_fused_rank_measure compiles and measures the fused generate ->
+    VAE-decode -> CLIP-rerank pipeline (tiny geometry)."""
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import DALLEConfig
+
+    monkeypatch.setattr(
+        bench, "cub200_config",
+        lambda use_pallas=False: DALLEConfig(
+            dim=32, num_text_tokens=64, text_seq_len=8, depth=2, heads=2,
+            dim_head=16, attn_types=("full", "axial_row"),
+            num_image_tokens=32, image_size=32, image_fmap_size=4,
+            dtype=jnp.float32))
+    measure = bench.make_fused_rank_measure(batch=2, num_images=4)
+    ips, dt = measure()
+    assert ips > 0 and dt > 0
 
 
 def test_vae_measure_tiny(monkeypatch):

@@ -372,6 +372,8 @@ def test_genrank_cli_with_clip_vit(trained_dalle, tiny_tokenizer_json,
     mname, mean, std = results.split(" ")
     # a real ranker produces non-degenerate logits
     assert float(std) >= 0.0 and mean not in ("nan", "0.0")
+    # fused default: the CLIP-ranked run wrote no intermediate image files
+    assert not list((workdir / "rank_vit_out").rglob("*.jpg"))
 
 
 @pytest.mark.slow
@@ -442,10 +444,13 @@ def test_genrank_ranking_order_with_trained_clip(tiny_tokenizer_json,
         lambda *a, **k: (cand, HugTokenizer(tiny_tokenizer_json)))
 
     out = tmp_path / "rank_out"
+    # --save_all: this test drives the legacy file-based path (its stub
+    # seam is generate_images; the fused default's scorer equivalence is
+    # pinned in tests/test_chip_equiv.py)
     genrank.main(["--dalle_path", "dalle-fake.pt", "--text", "red",
                   "--num_images", "6", "--bpe_path",
                   str(tiny_tokenizer_json), "--clip_path", str(clip_path),
-                  "--out_path", str(out)])
+                  "--out_path", str(out), "--save_all"])
 
     logits = np.load(out / "Bdalle-fake.npy")
     red_scores, blue_scores = logits[0::2], logits[1::2]
@@ -456,6 +461,9 @@ def test_genrank_ranking_order_with_trained_clip(tiny_tokenizer_json,
 
 
 def test_genrank_cli(trained_dalle, tiny_tokenizer_json, workdir):
+    """Default genrank = the fused on-device pipeline: full outputs
+    (results.txt, logits .npy, ranking grid) with ZERO intermediate image
+    files on disk — the JPEG round-trip is gone."""
     cwd = os.getcwd()
     os.chdir(workdir)
     try:
@@ -473,6 +481,35 @@ def test_genrank_cli(trained_dalle, tiny_tokenizer_json, workdir):
     line = (rank_out / "results.txt").read_text().strip().split(" ")
     assert len(line) == 3  # mname mean std
     assert list(rank_out.glob("B*.npy")) and list(rank_out.glob("B*.png"))
+    # zero intermediate image files: no per-candidate JPEGs, no per-model
+    # subfolder — the only image artifact is the final ranking grid
+    assert not list(rank_out.rglob("*.jpg"))
+    assert not [p for p in rank_out.iterdir() if p.is_dir()]
+
+
+def test_genrank_cli_save_all_keeps_file_artifacts(trained_dalle,
+                                                   tiny_tokenizer_json,
+                                                   workdir):
+    """--save_all preserves the reference's artifact behavior: every
+    candidate saved as a JPEG in the per-model folder and ranked from the
+    re-read files."""
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        import genrank
+
+        genrank.main(["--dalle_path", str(trained_dalle),
+                      "--text", "blue square",
+                      "--num_images", "4",
+                      "--bpe_path", str(tiny_tokenizer_json),
+                      "--out_path", str(workdir / "rank_all_out"),
+                      "--save_all"])
+    finally:
+        os.chdir(cwd)
+    rank_out = workdir / "rank_all_out"
+    assert (rank_out / "results.txt").exists()
+    jpgs = list(rank_out.rglob("*.jpg"))
+    assert len(jpgs) == 4  # one per candidate, in the per-model subfolder
 
 
 def test_legacy_ckpt_resume_with_flat_opt_state(trained_dalle, tiny_dataset,

@@ -274,6 +274,106 @@ def test_dense_decode_control_matches_sliced():
     np.testing.assert_array_equal(a, b)
 
 
+def test_tile_prefill_matches_batched_prefill(small):
+    """Shared prompt prefill: prefilling ONE row and tiling the state
+    (models.dalle.tile_prefill) must equal prefilling the repeated prompt
+    at full batch — logits and every layer's caches."""
+    from dalle_pytorch_tpu.models.dalle import prefill_codes, tile_prefill
+
+    cfg, dalle, params, text, _ = small
+    reps = 3
+    text_rep = jnp.repeat(text[:1], reps, axis=0)
+
+    fl1, c1 = prefill_codes(dalle, params, text[:1])
+    flt, ct = tile_prefill(fl1, c1, reps)
+    fln, cn = prefill_codes(dalle, params, text_rep)
+
+    np.testing.assert_allclose(np.asarray(flt), np.asarray(fln),
+                               rtol=1e-5, atol=1e-5)
+    assert len(ct) == len(cn)
+    for (kt, vt), (kn, vn) in zip(ct, cn):
+        assert kt.shape == kn.shape and kt.dtype == kn.dtype
+        np.testing.assert_allclose(np.asarray(kt, np.float32),
+                                   np.asarray(kn, np.float32),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(vt, np.float32),
+                                   np.asarray(vn, np.float32),
+                                   rtol=1e-5, atol=1e-5)
+
+    with pytest.raises(AssertionError):  # batch>1 prefills cannot be tiled
+        tile_prefill(fln, cn, 2)
+
+
+def test_split_sampler_composition_matches_generate_codes(small):
+    """prefill_codes + decode_codes (the split the shared-prefill path
+    uses) must reproduce generate_codes exactly for the same rng."""
+    from dalle_pytorch_tpu.models.dalle import (decode_codes, prefill_codes,
+                                                tile_prefill)
+
+    cfg, dalle, params, text, _ = small
+    rng = jax.random.PRNGKey(7)
+    whole = np.asarray(generate_codes(dalle, params, text, rng,
+                                      filter_thres=0.9))
+    fl, caches = prefill_codes(dalle, params, text)
+    split = np.asarray(decode_codes(dalle, params, fl, caches, rng,
+                                    filter_thres=0.9))
+    np.testing.assert_array_equal(whole, split)
+
+    # and through a tiled batch-1 prefill of a repeated prompt: greedy
+    # decode must equal the per-row generate_codes greedy output
+    thres = 1.0 - 1.0 / cfg.total_tokens
+    text_rep = jnp.repeat(text[:1], 2, axis=0)
+    ref = np.asarray(generate_codes(dalle, params, text_rep,
+                                    jax.random.PRNGKey(0),
+                                    filter_thres=thres))
+    fl1, c1 = prefill_codes(dalle, params, text[:1])
+    flt, ct = tile_prefill(fl1, c1, 2)
+    tiled = np.asarray(decode_codes(dalle, params, flt, ct,
+                                    jax.random.PRNGKey(0),
+                                    filter_thres=thres))
+    np.testing.assert_array_equal(ref, tiled)
+
+
+def test_generate_chunked_shared_prefill(small, monkeypatch):
+    """cli.generate_chunked with a repeated prompt must prefill ONCE
+    (shared-prefill path, tiled caches) and never call the per-chunk
+    generate_codes; distinct prompts keep the per-chunk path."""
+    from dalle_pytorch_tpu import cli
+
+    cfg, dalle, params, text, _ = small
+    calls = {"prefill": 0, "full": 0}
+    real_prefill, real_gen = cli.prefill_codes, cli.generate_codes
+
+    def counting_prefill(*a, **k):
+        calls["prefill"] += 1
+        return real_prefill(*a, **k)
+
+    def counting_gen(*a, **k):
+        calls["full"] += 1
+        return real_gen(*a, **k)
+
+    monkeypatch.setattr(cli, "prefill_codes", counting_prefill)
+    monkeypatch.setattr(cli, "generate_codes", counting_gen)
+
+    def decode(codes):
+        return jnp.zeros((codes.shape[0], 4, 4, 3))
+
+    tokens = np.repeat(np.asarray(text[:1]), 5, axis=0)
+    images, rng = cli.generate_chunked(
+        dalle, params["params"], decode, tokens, batch_size=2, top_k=0.9,
+        rng=jax.random.PRNGKey(0))
+    assert images.shape[0] == 5
+    assert calls == {"prefill": 1, "full": 0}
+
+    tokens2 = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(5), (3, cfg.text_seq_len), 1, 50))
+    images2, _ = cli.generate_chunked(
+        dalle, params["params"], decode, tokens2, batch_size=2, top_k=0.9,
+        rng=rng)
+    assert images2.shape[0] == 3
+    assert calls["full"] == 2  # two padded chunks, no shared prefill
+
+
 def test_phase_head_init_call_path_independent():
     """Initializing through a phase-only head caller (prefill computes only
     image-phase logits) must still create BOTH phase kernels — otherwise a

@@ -29,9 +29,9 @@ cd "$(dirname "$0")/.."
 # re-armed queue whose stage COMMANDS changed can never be skipped by a
 # stale marker from an older queue definition — bump QV whenever any
 # stage's command line changes.
-QV=8
+QV=9
 
-STAGES="ab_cand bench gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap"
+STAGES="gen_bf16_ab gen_fused_ab ab_cand bench gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap"
 
 # Overridable knobs so tests/test_babysitter.py can drive the REAL script
 # (fake python on PATH, private marker dir, second-scale sleeps) without
@@ -112,6 +112,14 @@ HARVEST_PID=$!
 trap 'harvest_once; kill "$HARVEST_PID" 2>/dev/null' EXIT
 
 # -- the queue, highest evidence value first -------------------------------
+# bf16 KV cache at eval dtype (f32 activations) vs the f32-cache control:
+# the decode loop is measured HBM-bound on cache reads (gen_ab 2.16x), so
+# this is the round's headline decode A/B.  Two cold decode-scan compiles
+# per stage is the ceiling (bench.py bounds one at 900s)
+run_stage gen_bf16_ab 2400 python tools/perf_ab.py gen_bf16 gen_f32cache --reps 2
+# fused generate→VAE-decode→CLIP-rerank pipeline wall-clock (genrank
+# rank_codes: shared prefill + zero disk round-trips), images-ranked/sec
+run_stage gen_fused_ab 1800 python tools/perf_ab.py gen_fused_rank --reps 2
 # candidate stack: the one A/B that decides the production config flip
 run_stage ab_cand   1500 python tools/perf_ab.py baseline candidate --reps 3
 # headline bench record (writes all-logs-tpu/bench-history.jsonl): one gen
@@ -136,7 +144,9 @@ run_stage loss_tpu  2400 python tools/loss_curve.py --captions real \
   --steps 10464 --num_pairs 10464 \
   --batch_size 16 --lr_plateau \
   --out all-logs-tpu/cub-captions-tpu.txt
-run_stage ab_ptiles 1500 python tools/perf_ab.py pallas pallas-b64 pallas-b256 --reps 2
+# tile ladder is 128 (plain pallas) / 256 / 512: sub-128 tiles cannot
+# lower on TPU and perf_ab rejects them at the API edge
+run_stage ab_ptiles 1500 python tools/perf_ab.py pallas pallas-b256 pallas-b512 --reps 2
 run_stage ab_batch  1500 python tools/perf_ab.py baseline batch64 batch128 --reps 2
 run_stage ab_fmap   1800 python tools/perf_ab.py fmap64 fmap64-pallas --reps 2
 echo "$(date +%T) all chip work finished"

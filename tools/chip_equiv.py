@@ -14,6 +14,7 @@ Exit 0 iff every check passes.
 from __future__ import annotations
 
 import sys
+import zlib
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -21,6 +22,17 @@ sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(REPO / "tests"))  # attention_refs: shared dense truth
 
 import jax
+
+# honor JAX_PLATFORMS=cpu over the sitecustomize-pinned tunnel plugin
+# BEFORE any backend query (the module-level jax.default_backend() below):
+# with the axon pin active and the tunnel down, that query otherwise hangs
+# forever with no exception — the documented CPU smoke mode was unreachable
+# (ADVICE.md round 5).  Same order tools/loss_curve.py uses.
+from dalle_pytorch_tpu.cli import apply_platform_env, enable_compilation_cache
+
+apply_platform_env()
+enable_compilation_cache()
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -48,7 +60,11 @@ def check_attention(block: int) -> None:
     for variant in ("full", "axial_row", "axial_col", "conv_like"):
         pattern = AttnPattern(variant=variant, seq_len=N - 1, text_len=TEXT,
                               fmap=FMAP)
-        ks = jax.random.split(jax.random.PRNGKey(hash(variant) % 2**31), 4)
+        # crc32, not hash(): python string hashes are per-process randomized
+        # (PYTHONHASHSEED), so an on-chip FAIL would draw different q/k/v on
+        # rerun and may not reproduce
+        ks = jax.random.split(
+            jax.random.PRNGKey(zlib.crc32(variant.encode())), 4)
         q, k, v = (jax.random.normal(kk, (B, H, N, DH), jnp.float32)
                    for kk in ks[:3])
         tangent = jax.random.normal(ks[3], (B, H, N, DH), jnp.float32)
@@ -120,10 +136,11 @@ def check_train_loss(block: int) -> None:
         raise SystemExit(1)
 
 
-def main() -> int:
+def main(argv=None) -> int:
     print(f"device: {jax.devices()[0].device_kind} "
           f"({jax.default_backend()})")
-    block = int(sys.argv[1]) if len(sys.argv) > 1 else BLOCK
+    argv = sys.argv[1:] if argv is None else list(argv)
+    block = int(argv[0]) if argv else BLOCK
     check_attention(block)
     check_train_loss(block)
     print("ALL EQUIVALENCE CHECKS PASSED (compiled kernels, "

@@ -78,7 +78,15 @@ VARIANTS = {
 # gen-dense: the sampler with the sliced-KV decode disabled (dense cache
 # reads every step) — the A/B control for ops/attention.py's
 # decode_key_positions gather.
-EXTRAS = ("gen", "gen64", "vae", "gen-dense")
+# gen_bf16 / gen_f32cache: the sampler at f32 activations (the checkpoint-
+# loaded eval path's dtype) with the bf16 KV cache ON vs OFF — the wall-
+# clock side of the kv_cache_bf16 byte-cut (the compiler gate is
+# tests/test_perf_model.py::test_bf16_cache_cuts_decode_cache_bytes).
+# gen_fused_rank: the fused generate→VAE-decode→CLIP-rerank pipeline
+# (genrank.rank_codes, shared prefill, zero disk round-trips), in
+# images-ranked/sec.
+EXTRAS = ("gen", "gen64", "vae", "gen-dense", "gen_bf16", "gen_f32cache",
+          "gen_fused_rank")
 
 
 def main(argv=None) -> int:
@@ -127,24 +135,36 @@ def main(argv=None) -> int:
             # sliced path under the gen-dense label
             measures[name] = bench.make_gen_measure(batch=8,
                                                     sliced_kv_decode=False)
+        elif name in ("gen_bf16", "gen_f32cache"):
+            # f32 activations (the eval path's dtype: checkpoints carry no
+            # dtype, so loaded models run f32) with the bf16 KV cache on
+            # vs off — like gen-dense, the choice rides the traced config
+            measures[name] = bench.make_gen_measure(
+                batch=8, dtype=jnp.float32,
+                kv_cache_bf16=(name == "gen_bf16"))
+        elif name == "gen_fused_rank":
+            measures[name] = bench.make_fused_rank_measure(batch=8)
         elif name == "vae":
             measures[name] = bench.make_vae_measure()
         else:
             measures[name] = bench.make_train_measure(
                 args.steps, **VARIANTS[name])[0]
 
+    def unit(name):
+        if name == "gen_fused_rank":  # rank_codes reports whole images
+            return "img/s"
+        return "tok/s" if name.startswith("gen") else "img/s"
+
     results = {name: [] for name in measures}
     for rep in range(args.reps):
         for name, measure in measures.items():  # interleaved round-robin
             v, _ = measure()
             results[name].append(v)
-            unit = "tok/s" if name.startswith("gen") else "img/s"
-            print(f"rep{rep} {name:12s} {v:9.2f} {unit}", flush=True)
+            print(f"rep{rep} {name:12s} {v:9.2f} {unit(name)}", flush=True)
 
     print("\nmedians:")
     for name, vals in results.items():
-        unit = "tok/s" if name.startswith("gen") else "img/s"
-        print(f"  {name:12s} {statistics.median(vals):9.2f} {unit}  "
+        print(f"  {name:12s} {statistics.median(vals):9.2f} {unit(name)}  "
               f"(spread {min(vals):.2f}-{max(vals):.2f})")
     return 0
 
