@@ -184,6 +184,7 @@ def run(use_pallas: bool = False, steps: int = STEPS):
     batch = int(os.environ.get("BENCH_BATCH", 16))
     use_pallas = use_pallas or env_flag("BENCH_PALLAS")
     overrides = dict(use_pallas=use_pallas)
+    # graftlint: disable=ENV001 (value-valued: the value IS the tile size; 0 is not a valid block)
     if use_pallas and os.environ.get("BENCH_PALLAS_BLOCK"):
         blk = int(os.environ["BENCH_PALLAS_BLOCK"])
         overrides.update(pallas_block_q=blk, pallas_block_k=blk)
@@ -381,6 +382,7 @@ def _bounded_call(fn):
     def work():
         try:
             box["result"] = fn()
+        # graftlint: disable=EXC001 (watchdog thread: the error is transported to the caller via box and re-raised there)
         except BaseException as e:  # noqa: BLE001
             box["error"] = e
 
@@ -487,6 +489,7 @@ def _run_with_retry(attempts: int = None, wait_s: float = None):
                 break
         except AssertionError:
             raise  # non-finite loss is a real regression, never flakiness
+        # graftlint: disable=EXC001 (retry loop: the error is kept as last_err and re-raised when no attempt succeeds)
         except Exception as e:  # noqa: BLE001 - tunnel errors vary by layer
             last_err = e
             print(f"bench attempt {attempt + 1}/{attempts} failed: {e}",
@@ -549,6 +552,7 @@ def main():
         try:
             if jax.devices()[0].platform == "cpu":
                 return
+            # graftlint: disable=ENV001 (path-valued var: empty/unset mean default)
             history = os.environ.get("BENCH_HISTORY") or os.path.join(
                 os.path.dirname(os.path.abspath(__file__)),
                 "all-logs-tpu", "bench-history.jsonl")
@@ -558,6 +562,7 @@ def main():
                     "device": jax.devices()[0].device_kind,
                     **record,
                 }) + "\n")
+        # graftlint: disable=EXC001 (informational history write: must never cost the round its recorded metric)
         except Exception as e:  # noqa: BLE001 — the tunnel can die between
             # the measurement and this write (XlaRuntimeError, not OSError);
             # history is informational and must never cost the round's metric
@@ -581,6 +586,7 @@ def main():
                 fn, timeout_s or _attempt_timeout() * 2, label)
             print(report(result), file=sys.stderr)
             return result
+        # graftlint: disable=EXC001 (informational stage after the JSON is out; a wedged tunnel here must not kill the record)
         except Exception as e:  # informational only — the JSON is already out
             print(f"{label} bench skipped: {e}", file=sys.stderr)
             return None

@@ -17,6 +17,7 @@ import numpy as np
 
 from . import DALLE, DALLEConfig, DiscreteVAE, VAEConfig
 from .data.tokenizer import ChineseTokenizer, HugTokenizer, SimpleTokenizer
+from .utils.helpers import env_flag
 from .models.dalle import (decode_codes, generate_codes, prefill_codes,
                            tile_prefill)
 from .utils.checkpoint import (load_checkpoint, migrate_head_kernels,
@@ -33,8 +34,9 @@ def enable_compilation_cache(path: Optional[str] = None,
     retunes the threshold or redirects the directory."""
     import os
 
-    if os.environ.get("DALLE_TPU_NO_COMPILE_CACHE"):
+    if env_flag("DALLE_TPU_NO_COMPILE_CACHE"):
         return
+    # graftlint: disable=ENV001 (path-valued var: empty/unset mean default)
     path = path or os.environ.get(
         "DALLE_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/dalle_tpu_xla"))
     try:

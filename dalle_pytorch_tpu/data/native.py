@@ -31,7 +31,11 @@ def _load() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("DALLE_TPU_NO_NATIVE"):
+        # env_flag semantics: DALLE_TPU_NO_NATIVE=0 must mean "native ON"
+        # (imported lazily — this module stays importable without jax)
+        from ..utils.helpers import env_flag
+
+        if env_flag("DALLE_TPU_NO_NATIVE"):
             return None
         def build() -> bool:
             try:

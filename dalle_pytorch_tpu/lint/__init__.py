@@ -1,0 +1,30 @@
+"""graftlint — TPU/JAX static analysis distilled from this repo's bug history.
+
+Five review rounds each burned scarce TPU-tunnel windows rediscovering bug
+classes that are statically detectable on CPU in seconds (ISSUE 2 / ADVICE
+rounds 3-5): raw env-var truthiness treating ``FLAG=0`` as ON, ``hash()``
+seeds that don't reproduce across processes, module-level backend queries
+that hang when the axon tunnel is pinned-but-down, mixed-dtype dots whose
+f32-accumulation contract held only by convention, host syncs inside traced
+code, and broad excepts swallowing XLA errors.  This package is the rule
+engine; ``tools/graftlint.py`` is the CLI and ``tools/contract_check.py``
+is the companion dynamic-contract checker (``jax.eval_shape``, zero FLOPs).
+
+Every rule supports an inline suppression pragma **with a mandatory
+justification**::
+
+    if os.environ.get("ADDR"):  # graftlint: disable=ENV001 (address-valued)
+
+A pragma without a parenthesized reason is itself an error (PRAGMA001) —
+suppressions document *why* the rule does not apply, or they don't count.
+"""
+from .engine import (Finding, filter_baseline, fingerprint, fix_env001,
+                     iter_python_files, lint_paths, lint_source,
+                     load_baseline, write_baseline)
+from .rules import RULES
+
+__all__ = [
+    "Finding", "RULES", "lint_source", "lint_paths", "fingerprint",
+    "iter_python_files",
+    "load_baseline", "write_baseline", "filter_baseline", "fix_env001",
+]

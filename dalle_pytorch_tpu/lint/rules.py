@@ -1,0 +1,295 @@
+"""The graftlint rule catalog — each rule is one bug class this repo has
+actually shipped (or nearly shipped) and then paid chip time to find.
+
+A rule is a function ``(FileCtx) -> Iterator[(node, message)]``; the engine
+owns pragma handling, baselines and reporting.  Rules are deliberately
+syntactic (no type inference): they over-approximate, and the pragma's
+mandatory justification is the escape hatch where the human knows better.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, List, Tuple
+
+RuleHit = Tuple[ast.AST, str]
+
+
+@dataclasses.dataclass
+class FileCtx:
+    """Parsed source handed to each rule."""
+
+    path: str
+    tree: ast.Module
+    lines: List[str]
+
+
+# --- helpers -------------------------------------------------------------
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of a Name/Attribute chain ('jax.lax.scan'), '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_env_get(call: ast.Call) -> bool:
+    """``os.environ.get(...)`` / ``environ.get(...)`` / ``os.getenv(...)``."""
+    chain = _attr_chain(call.func)
+    return chain.endswith("environ.get") or chain.endswith("os.getenv") \
+        or chain == "getenv"
+
+
+def _walk_skip_defs(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function/class bodies."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+# --- ENV001: raw truthiness on os.environ.get ----------------------------
+
+
+def rule_env001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """``bool(os.environ.get(X))`` treats ``X=0`` as ON — an operator
+    disabling a flag with 0 silently enables it (the BENCH_PALLAS /
+    GRAFT_DRYRUN_FULL footgun, hit twice).  Boolean env knobs must parse
+    through ``utils.helpers.env_flag``; value-valued vars where truthiness
+    is genuinely presence-of-value (addresses, paths) carry a pragma."""
+    msg = ("raw truthiness on an environment read ('VAR=0' counts as ON); "
+           "use dalle_pytorch_tpu.utils.helpers.env_flag for boolean flags")
+    truth_exprs: list = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            truth_exprs.append(node.test)
+        elif isinstance(node, ast.Assert):
+            truth_exprs.append(node.test)
+        elif isinstance(node, ast.BoolOp):
+            truth_exprs.extend(node.values)
+        elif isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            truth_exprs.append(node.operand)
+        elif isinstance(node, ast.comprehension):
+            truth_exprs.extend(node.ifs)
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "bool":
+            truth_exprs.extend(node.args)
+    for expr in truth_exprs:
+        if isinstance(expr, ast.Call) and _is_env_get(expr):
+            yield expr, msg
+
+
+# --- SEED001: hash()-derived seeds ---------------------------------------
+
+
+def rule_seed001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """Python string hashes are per-process randomized (PYTHONHASHSEED), so
+    a ``hash()``-derived seed draws different data on every rerun — an
+    on-chip FAIL that doesn't reproduce (the round-5 ``chip_equiv`` bug).
+    Use ``zlib.crc32`` for stable content-derived seeds."""
+    msg = ("hash() is per-process randomized (PYTHONHASHSEED) — a seed or "
+           "PRNGKey derived from it will not reproduce across reruns; use "
+           "zlib.crc32 for stable content-derived seeds")
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "hash":
+            yield node, msg
+
+
+# --- BACKEND001: module-level backend queries ----------------------------
+
+_BACKEND_QUERIES = frozenset((
+    "devices", "local_devices", "default_backend", "device_count",
+    "local_device_count", "process_count", "process_index",
+))
+
+
+def rule_backend001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """A module-level ``jax.devices()`` / ``jax.default_backend()`` runs at
+    import time — and with the axon tunnel's sitecustomize plugin pinned
+    but the tunnel down, backend init hangs >9 min inside the query with no
+    exception (ADVICE round 5).  ``cli.apply_platform_env()`` must run
+    first (module-level, earlier in the file) so ``JAX_PLATFORMS=cpu``
+    actually takes effect before the backend initializes."""
+    msg = ("module-level {} initializes the JAX backend at import time; "
+           "call cli.apply_platform_env() first (earlier at module level) "
+           "so JAX_PLATFORMS=cpu is honored before any backend query")
+    platform_line = None
+    queries = []
+    for node in _walk_skip_defs(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain.endswith("apply_platform_env"):
+            if platform_line is None or node.lineno < platform_line:
+                platform_line = node.lineno
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _BACKEND_QUERIES \
+                and _attr_chain(node.func.value) == "jax":
+            queries.append((node, chain))
+    for node, chain in queries:
+        if platform_line is None or node.lineno < platform_line:
+            yield node, msg.format(f"{chain}()")
+
+
+# --- DOT001: dot-family calls without an accumulation contract -----------
+
+_DOT_FUNCS = frozenset(("einsum", "dot", "matmul", "tensordot"))
+_JAX_NUMPY_RECEIVERS = frozenset(("jnp", "jax.numpy", "jaxnp"))
+_LAX_RECEIVERS = frozenset(("lax", "jax.lax"))
+
+
+def rule_dot001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """A jnp dot/einsum with no ``preferred_element_type`` leaves the
+    accumulation dtype to inference from the (possibly mixed) operand
+    dtypes — and lets XLA satisfy a mixed-dtype dot by hoisting a full
+    f32 convert of the wider operand (the bf16-KV-cache defeat PR 1
+    measured: it more than doubled decode cache bytes).  Every jnp-level
+    dot states ``preferred_element_type`` explicitly, or carries a pragma
+    proving the operand dtypes are uniform by construction."""
+    msg = ("{} without preferred_element_type: the accumulation/output "
+           "dtype is inferred from operand dtypes, and a mixed-dtype dot "
+           "lets XLA materialize a full f32 convert of the wider operand; "
+           "pass preferred_element_type (usually jnp.float32) or pragma "
+           "with a proof the operands are dtype-uniform")
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        recv = _attr_chain(node.func.value)
+        is_dot = (node.func.attr in _DOT_FUNCS
+                  and recv in _JAX_NUMPY_RECEIVERS) \
+            or (node.func.attr == "dot_general" and recv in _LAX_RECEIVERS)
+        if not is_dot:
+            continue
+        if any(kw.arg == "preferred_element_type" for kw in node.keywords):
+            continue
+        yield node, msg.format(f"{recv}.{node.func.attr}")
+
+
+# --- TRACE001: host syncs inside traced code -----------------------------
+
+_SCAN_BODY_ARGS = {  # callable-position args of the structured control flow
+    "scan": (0,), "map": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "switch": ()  # switch takes a list — handled below
+}
+_HOST_SYNC_RECEIVERS = frozenset(("np", "numpy", "onp"))
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) / @jax.jit(...) / @nn.jit(...)
+        chain = _attr_chain(dec.func)
+        if chain.endswith("partial") and dec.args:
+            return _attr_chain(dec.args[0]).endswith("jit")
+        return chain.endswith("jit") or chain.endswith("pjit")
+    return _attr_chain(dec).endswith("jit") or _attr_chain(dec).endswith("pjit")
+
+
+def rule_trace001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """``.item()`` / ``np.asarray`` / ``float()`` on a traced value inside a
+    ``@jax.jit`` or ``lax.scan`` body either fails at trace time on a path
+    nobody ran, or (worse, via callbacks/weak types) forces a device sync
+    per step.  Host fetches belong outside the traced program."""
+    msg = ("host-sync call {} inside a traced ({}) body: this blocks on "
+           "device transfer per trace or fails on untested paths; hoist "
+           "the host fetch out of the traced program")
+    traced: list = []  # (body_root, why)
+    defs_by_name: dict = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                traced.append((node, "@jit"))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        if _attr_chain(node.func.value) not in _LAX_RECEIVERS:
+            continue
+        for pos in _SCAN_BODY_ARGS.get(node.func.attr, ()):
+            if pos >= len(node.args):
+                continue
+            arg = node.args[pos]
+            if isinstance(arg, ast.Lambda):
+                traced.append((arg, f"lax.{node.func.attr}"))
+            elif isinstance(arg, ast.Name) and arg.id in defs_by_name:
+                traced.append((defs_by_name[arg.id],
+                               f"lax.{node.func.attr}"))
+
+    seen = set()
+    for body_root, why in traced:
+        for node in ast.walk(body_root):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            bad = None
+            if isinstance(node.func, ast.Attribute):
+                recv = _attr_chain(node.func.value)
+                if node.func.attr == "item" and not node.args:
+                    bad = ".item()"
+                elif node.func.attr in ("asarray", "array") \
+                        and recv in _HOST_SYNC_RECEIVERS:
+                    bad = f"{recv}.{node.func.attr}()"
+                elif node.func.attr == "device_get" and recv == "jax":
+                    bad = "jax.device_get()"
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int") \
+                    and len(node.args) == 1 \
+                    and isinstance(node.args[0],
+                                   (ast.Attribute, ast.Subscript)):
+                bad = f"{node.func.id}()"
+            if bad:
+                seen.add(id(node))
+                yield node, msg.format(bad, why)
+
+
+# --- EXC001: broad excepts that swallow XLA errors -----------------------
+
+
+def rule_exc001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """``except:`` / ``except Exception:`` with no re-raise swallows
+    ``XlaRuntimeError`` — which is how a wedged tunnel, an OOM, or a
+    cross-host desync presents.  A swallowed one turns a loud failure into
+    silent corruption.  Narrow the class, re-raise, or pragma with the
+    reason this specific handler may eat everything."""
+    msg = ("{} swallows XlaRuntimeError (wedged tunnel / OOM / desync "
+           "present as generic exceptions); catch a narrower class, "
+           "re-raise, or pragma with why swallowing is safe here")
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            label = "bare 'except:'"
+        else:
+            names = [node.type] if not isinstance(node.type, ast.Tuple) \
+                else list(node.type.elts)
+            broad = [n for n in names
+                     if _attr_chain(n).split(".")[-1] in ("Exception",
+                                                          "BaseException")]
+            if not broad:
+                continue
+            label = f"'except {_attr_chain(broad[0])}:'"
+        if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+            continue  # the handler re-raises — errors still propagate
+        yield node, msg.format(label)
+
+
+RULES = {
+    "ENV001": rule_env001,
+    "SEED001": rule_seed001,
+    "BACKEND001": rule_backend001,
+    "DOT001": rule_dot001,
+    "TRACE001": rule_trace001,
+    "EXC001": rule_exc001,
+}

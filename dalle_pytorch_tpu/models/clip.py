@@ -124,9 +124,11 @@ class CLIP(nn.Module):
 
         if not return_loss:
             # per-pair similarity scores (ref :278-280)
-            return jnp.einsum("nd,nd->n", text_latents, image_latents) * temp
+            return jnp.einsum("nd,nd->n", text_latents, image_latents,
+                              preferred_element_type=jnp.float32) * temp
 
-        sim = jnp.einsum("id,jd->ij", text_latents, image_latents) * temp
+        sim = jnp.einsum("id,jd->ij", text_latents, image_latents,
+                         preferred_element_type=jnp.float32) * temp
         b = sim.shape[0]
         labels = jnp.arange(b)
         logp_t = jax.nn.log_softmax(sim, axis=-1)

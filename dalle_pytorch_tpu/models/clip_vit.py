@@ -90,7 +90,10 @@ class ResidualAttentionBlock(nn.Module):
             mask = jnp.tril(jnp.ones((n, n), bool))
             s = jnp.where(mask[None, None], s, -1e30)
         a = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-        o = jnp.einsum("bhij,bhjd->bhid", a, v)
+        # bf16 multiplicands, f32 accumulation (the MXU native mode);
+        # the result is cast back so out_proj sees the activation dtype
+        o = jnp.einsum("bhij,bhjd->bhid", a, v,
+                       preferred_element_type=jnp.float32).astype(x.dtype)
         o = o.transpose(0, 2, 1, 3).reshape(b, n, w)
         return self.out_proj(o)
 

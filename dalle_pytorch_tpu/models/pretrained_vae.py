@@ -215,9 +215,16 @@ class _VQAttnBlock(nn.Module):
         q = nn.Conv(c, (1, 1), dtype=self.dtype, name="q")(hn).reshape(b, h * w, c)
         k = nn.Conv(c, (1, 1), dtype=self.dtype, name="k")(hn).reshape(b, h * w, c)
         v = nn.Conv(c, (1, 1), dtype=self.dtype, name="v")(hn).reshape(b, h * w, c)
+        # scores/softmax accumulate in f32 even under a bf16 dtype; the
+        # attn @ v contraction keeps cache-dtype multiplicands with f32
+        # accumulation (same contract as ops/attention.py)
         attn = jax.nn.softmax(
-            jnp.einsum("bic,bjc->bij", q, k) * (c ** -0.5), axis=-1)
-        o = jnp.einsum("bij,bjc->bic", attn, v).reshape(b, h, w, c)
+            jnp.einsum("bic,bjc->bij", q, k,
+                       preferred_element_type=jnp.float32) * (c ** -0.5),
+            axis=-1)
+        o = jnp.einsum("bij,bjc->bic", attn.astype(v.dtype), v,
+                       preferred_element_type=jnp.float32
+                       ).astype(x.dtype).reshape(b, h, w, c)
         return x + nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_out")(o)
 
 

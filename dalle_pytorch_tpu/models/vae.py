@@ -206,6 +206,7 @@ class DiscreteVAE(nn.Module):
         sampled = jnp.einsum(
             "bhwn,nd->bhwd", soft_one_hot,
             self.codebook.embedding.astype(soft_one_hot.dtype),
+            preferred_element_type=jnp.float32,
         )
         out = self.decoder(sampled.astype(cfg.dtype))
 

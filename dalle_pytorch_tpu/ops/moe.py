@@ -83,12 +83,14 @@ class MoEFeedForward(nn.Module):
             # expert axis leads, so give them a slot axis to broadcast over
             bi = b_in[:, None] if expert_leading else b_in
             bo = b_out[:, None] if expert_leading else b_out
+            # graftlint: disable=DOT001 (uniform: h and w_in are both cast to self.dtype)
             h = jnp.einsum(in_spec, h, w_in) + bi
             h, gates = jnp.split(h, 2, axis=-1)
             h = h * nn.gelu(gates)
             # dropout on the inner activation, matching FFBlock's placement
             # (between the GEGLU gate and the output projection)
             h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+            # graftlint: disable=DOT001 (uniform: h and w_out are both cast to self.dtype)
             return jnp.einsum(out_spec, h, w_out) + bo
 
         return ff
@@ -124,6 +126,7 @@ class MoEFeedForward(nn.Module):
         if self.dispatch == "dense":
             # every expert sees every token; combine zeroes the non-routed
             y = ff(xc, "bnd,edi->bnei", "bnei,eid->bned")  # [b, n, e, d]
+            # graftlint: disable=DOT001 (uniform: combine is cast to y's self.dtype)
             y = jnp.einsum("bned,bne->bnd", y, combine.astype(self.dtype))
             return y.astype(x.dtype), aux.astype(jnp.float32)
 
@@ -160,9 +163,11 @@ class MoEFeedForward(nn.Module):
             counts = counts + oh.sum(axis=1)
 
         combine_slots = dispatch * flat_gate.astype(self.dtype)[..., None]
+        # graftlint: disable=DOT001 (uniform: dispatch is built in self.dtype, xf cast to it)
         expert_in = jnp.einsum("gtec,gtd->gecd", dispatch, xf)  # [G, e, C, d]
         y = ff(expert_in, "gecd,edi->geci", "geci,eid->gecd",
                expert_leading=True)                             # [G, e, C, d]
+        # graftlint: disable=DOT001 (uniform: combine_slots and y are both self.dtype)
         out = jnp.einsum("gtec,gecd->gtd", combine_slots, y)    # dropped -> 0
         out = out.reshape(Tp, d)[:T]
         return out.reshape(b, n, d).astype(x.dtype), aux.astype(jnp.float32)

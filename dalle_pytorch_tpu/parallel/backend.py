@@ -161,6 +161,7 @@ def _cluster_env_hints() -> list:
     import os
 
     hints = []
+    # graftlint: disable=ENV001 (address-valued: any non-empty value IS the hint)
     if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
         hints.append("MEGASCALE_COORDINATOR_ADDRESS")  # multislice-only var
     workers = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")

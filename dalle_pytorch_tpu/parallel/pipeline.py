@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import shard_map
+
 
 def stack_stage_params(params: dict, depth: int, pp: int,
                        layer_prefixes: tuple = ("layers_{i}_attn",
@@ -133,7 +135,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *,
             f"dp_axis {dp_axis!r} is not a mesh axis {mesh.axis_names}")
     # microbatch axis stays whole per stage; batch-within-microbatch on dp
     x_spec = P(None, dp_axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         run, mesh=mesh, in_specs=(P(pp_axis), x_spec), out_specs=x_spec,
         check_vma=False)
     outs = fn(stacked_params, xs)

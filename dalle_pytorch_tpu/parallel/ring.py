@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import AttnPattern, _allowed
+from .mesh import shard_map
 
 NEG_INF = -1e30
 
@@ -73,7 +74,8 @@ def ring_attention(q, k, v, *, axis_name: str,
         """Online-softmax update against the chunk currently held, which
         originated on device (idx - r) mod sp."""
         src = jax.lax.rem(idx - r + sp, sp)
-        s = jnp.einsum("bhid,bhjd->bhij", qf, k_r.astype(jnp.float32))
+        s = jnp.einsum("bhid,bhjd->bhij", qf, k_r.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
         allow = _chunk_mask(pattern, causal, idx * nl, src * nl, nl, nl,
                             layout=layout)
         s = jnp.where(allow[None, None], s, NEG_INF)
@@ -84,7 +86,8 @@ def ring_attention(q, k, v, *, axis_name: str,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.einsum(
-            "bhij,bhjd->bhid", p, v_r.astype(jnp.float32))
+            "bhij,bhjd->bhid", p, v_r.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     def step(r, carry):
@@ -115,7 +118,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, *, sp_axis: str = "sp",
 
     fn = partial(ring_attention, axis_name=sp_axis, pattern=pattern,
                  causal=causal)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return sharded(q, k, v)

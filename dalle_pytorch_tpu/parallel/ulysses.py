@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import AttnPattern
+from .mesh import shard_map
 from .ring import NEG_INF, _chunk_mask
 
 
@@ -62,12 +63,14 @@ def ulysses_attention(q, k, v, *, axis_name: str,
     n = qg.shape[2]
 
     s = jnp.einsum("bhid,bhjd->bhij", qg.astype(jnp.float32) * scale,
-                   kg.astype(jnp.float32))
+                   kg.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
     allow = _chunk_mask(pattern, causal, 0, 0, n, n, layout=layout)
     s = jnp.where(allow[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     p = jnp.where(allow[None, None], p, 0.0)  # fully-masked rows -> 0
-    out = jnp.einsum("bhij,bhjd->bhid", p, vg.astype(jnp.float32))
+    out = jnp.einsum("bhij,bhjd->bhid", p, vg.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
     # one collective out: split the sequence back, gather heads
     return jax.lax.all_to_all(out.astype(q.dtype), axis_name, split_axis=2,
                               concat_axis=1, tiled=True)
@@ -84,7 +87,7 @@ def ulysses_attention_sharded(q, k, v, mesh: Mesh, *, sp_axis: str = "sp",
 
     fn = partial(ulysses_attention, axis_name=sp_axis, pattern=pattern,
                  causal=causal)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return sharded(q, k, v)

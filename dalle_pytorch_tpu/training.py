@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .parallel.mesh import shard_map
+
 
 def _adam_chain(learning_rate, grad_clip_norm=0.0):
     steps = []
@@ -141,7 +143,7 @@ def make_dalle_sp_train_step(dalle, tx, mesh, dp_axis: str = "dp",
                                rngs={"dropout": rng})
             return jax.lax.pmean(loss, dp_axis)
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(), P(dp_axis), P(dp_axis), P()),
             out_specs=P(), check_vma=False)(params, text, codes, rng)

@@ -117,6 +117,82 @@ def save_checkpoint_sharded(path: str | Path, obj: dict) -> None:
         ckptr.save(path, args=ocp.args.PyTreeSave(obj), force=True)
 
 
+def _checkpoint_meta_tree(ckptr, path):
+    """Checkpoint metadata tree across orbax generations: older versions
+    return it directly, newer ones wrap it as ``.item_metadata.tree``."""
+    meta = ckptr.metadata(path)
+    meta = getattr(meta, "item_metadata", meta)
+    return getattr(meta, "tree", meta)
+
+
+def _fill_skips_from_meta(item, meta, repl):
+    """Replace ``...`` skip-leaves with replicated ShapeDtypeStruct targets
+    read off the checkpoint metadata (structure-parallel walk)."""
+    if item is ...:
+        return jax.ShapeDtypeStruct(tuple(meta.shape), meta.dtype,
+                                    sharding=repl)
+    if isinstance(item, dict):
+        return {k: _fill_skips_from_meta(v, meta[k], repl)
+                for k, v in item.items()}
+    if isinstance(item, list):
+        return [_fill_skips_from_meta(v, m, repl)
+                for v, m in zip(item, meta)]
+    return item
+
+
+def _reinsert_skips(template, restored):
+    """Walk ``template`` and the restore output in parallel, putting the
+    ``...`` sentinel back at every skipped position."""
+    if template is ...:
+        return ...
+    if isinstance(template, dict):
+        return {k: _reinsert_skips(v, restored[k])
+                for k, v in template.items()}
+    if isinstance(template, list):
+        return [_reinsert_skips(v, r) for v, r in zip(template, restored)]
+    return restored
+
+
+def _rebuffer_cpu(tree):
+    """Copy restored arrays into XLA-allocated buffers on the CPU backend.
+
+    XLA:CPU (jax 0.4.37) segfaults outright when a *donating* executable —
+    specifically one deserialized from the persistent compile cache —
+    consumes buffers that orbax/tensorstore allocated rather than XLA
+    (observed: sharded-resume params fed to the cached train step).  An
+    eager ``jnp.copy`` reallocates through XLA and keeps each leaf's
+    sharding; TPU restores keep the zero-copy path."""
+    if jax.default_backend() != "cpu":
+        return tree
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree)
+
+
+def _restore_with_skips(ckptr, ocp, path, item):
+    """Restore ``item``, where a ``...`` leaf means "skip reading this
+    leaf".  orbax >= 0.9 understands the sentinel natively
+    (``ocp.PLACEHOLDER`` is ``...``).  Older orbax has no placeholder
+    concept, so skipped leaves are restored by value onto a replicated
+    sharding (shape/dtype from the checkpoint metadata) and then dropped —
+    same results, just without the lazy-read memory win; multi-host pods
+    (where that win matters) run new enough orbax for the native path."""
+    has_skips = any(
+        leaf is ... for leaf in
+        jax.tree.leaves(item, is_leaf=lambda l: l is ...))
+    if not has_skips or hasattr(ocp, "PLACEHOLDER"):
+        return _rebuffer_cpu(ckptr.restore(path, args=ocp.args.PyTreeRestore(
+            item=item,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(item))))
+    filled = _fill_skips_from_meta(item, _checkpoint_meta_tree(ckptr, path),
+                                   _replicated_sharding())
+    out = ckptr.restore(path, args=ocp.args.PyTreeRestore(
+        item=filled,
+        restore_args=ocp.checkpoint_utils.construct_restore_args(filled)))
+    return _reinsert_skips(item, _rebuffer_cpu(out))
+
+
 def load_checkpoint_sharded(path: str | Path, target=None):
     """Restore an Orbax checkpoint directory.  With `target` (a pytree of
     jax.ShapeDtypeStruct with shardings, or arrays), arrays restore directly
@@ -132,11 +208,9 @@ def load_checkpoint_sharded(path: str | Path, target=None):
         if target is None:
             return ckptr.restore(path)
         # target leaves may be: ShapeDtypeStruct w/ sharding (restore onto
-        # it), a plain value (restored by value), or ocp.PLACEHOLDER (skip
-        # this leaf entirely — it comes back as the Ellipsis sentinel)
-        return ckptr.restore(path, args=ocp.args.PyTreeRestore(
-            item=target,
-            restore_args=ocp.checkpoint_utils.construct_restore_args(target)))
+        # it), a plain value (restored by value), or the ``...`` sentinel
+        # (skip this leaf entirely — it comes back as ``...``)
+        return _restore_with_skips(ckptr, ocp, path, target)
 
 
 def is_sharded_checkpoint(path: str | Path) -> bool:
@@ -164,7 +238,7 @@ def load_sharded_small(path: str | Path):
     # them "by value" leaves the deserializer without one and fails
     repl = _replicated_sharding()
     with ocp.PyTreeCheckpointer() as ckptr:
-        meta = ckptr.metadata(path).item_metadata.tree
+        meta = _checkpoint_meta_tree(ckptr, path)
 
         def to_item(node):
             if isinstance(node, dict):
@@ -177,7 +251,7 @@ def load_sharded_small(path: str | Path):
             # item leaf is an empty subtree to orbax and never gets restored
             shape = getattr(node, "shape", None)
             if shape:  # non-empty tuple
-                return ocp.PLACEHOLDER
+                return ...  # skip sentinel (ocp.PLACEHOLDER on new orbax)
             dtype = getattr(node, "dtype", None)
             if dtype is not None:
                 if getattr(node, "sharding", None) is not None:
@@ -186,9 +260,7 @@ def load_sharded_small(path: str | Path):
             return ""  # string leaf
 
         item = to_item(meta)
-        return ckptr.restore(path, args=ocp.args.PyTreeRestore(
-            item=item,
-            restore_args=ocp.checkpoint_utils.construct_restore_args(item)))
+        return _restore_with_skips(ckptr, ocp, path, item)
 
 
 def migrate_head_kernels(tree, total_text: int):

@@ -35,7 +35,7 @@ PEAK_FLOPS = (
 def device_peak_flops(default: float = 197e12) -> float:
     try:
         kind = jax.devices()[0].device_kind.lower()
-    except Exception:  # pragma: no cover - no devices
+    except Exception:  # pragma: no cover — graftlint: disable=EXC001 (no-device probe: any backend failure means fall back to the analytic default)
         return default
     for sub, peak in PEAK_FLOPS:
         if sub in kind:
@@ -137,7 +137,7 @@ def compiled_cost_summary(fn, *args, donate_argnums=(),
         out.update(temp_bytes=ma.temp_size_in_bytes,
                    argument_bytes=ma.argument_size_in_bytes,
                    output_bytes=ma.output_size_in_bytes)
-    except Exception:  # pragma: no cover — backends without memory analysis
+    except Exception:  # pragma: no cover — graftlint: disable=EXC001 (optional XLA API: absence just skips the optional memory fields)
         pass
     return out
 

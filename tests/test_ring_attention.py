@@ -12,6 +12,7 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dalle_pytorch_tpu.ops.attention import AttnPattern
+from dalle_pytorch_tpu.parallel.mesh import shard_map
 from dalle_pytorch_tpu.parallel.ring import ring_attention_sharded
 
 from attention_refs import dense_reference
@@ -114,7 +115,7 @@ def test_transformer_sequence_parallel(mesh8):
     ref = dense_tf.apply({"params": params}, x)
 
     spec = P(None, "sp", None)
-    sp_apply = jax.shard_map(
+    sp_apply = shard_map(
         lambda p, x: ring_tf.apply({"params": p}, x),
         mesh=mesh8, in_specs=(P(), spec), out_specs=spec, check_vma=False)
     out = jax.jit(sp_apply)(params, x)

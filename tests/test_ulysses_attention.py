@@ -9,6 +9,7 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dalle_pytorch_tpu.ops.attention import AttnPattern
+from dalle_pytorch_tpu.parallel.mesh import shard_map
 from dalle_pytorch_tpu.parallel.ulysses import ulysses_attention_sharded
 
 from attention_refs import dense_reference
@@ -110,7 +111,7 @@ def test_transformer_ulysses_matches_local(mesh2x4):
     ref = tf_local.apply({"params": params}, x)
 
     spec = P("dp", "sp", None)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p, x: tf_sp.apply({"params": p}, x),
         mesh=mesh2x4, in_specs=(P(), spec), out_specs=spec, check_vma=False)
     with mesh2x4:

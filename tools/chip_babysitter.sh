@@ -111,6 +111,21 @@ harvest_once() { # finished stage logs -> committable repo path
 HARVEST_PID=$!
 trap 'harvest_once; kill "$HARVEST_PID" 2>/dev/null' EXIT
 
+# -- chip-free pre-flight gate ---------------------------------------------
+# contract_check statically asserts the invariants the A/Bs below measure
+# (bf16 cache dtype, f32 accumulation, shardings resolve) via eval_shape on
+# CPU — zero FLOPs, no tunnel, seconds.  A dead invariant must never reach
+# the chip queue: every stage after it would measure a broken program, so
+# refuse to arm instead.  No marker file — the gate is cheap and re-runs on
+# every (re-)arm so a regression between arms is still caught.
+echo "$(date +%T) pre-flight: chip-free contract check"
+if ! env JAX_PLATFORMS=cpu timeout 600 python tools/contract_check.py \
+    > "${CHIP_TMP}/chip_contract_check.log" 2>&1; then
+  echo "$(date +%T) contract check FAILED — refusing to arm the chip queue (see ${CHIP_TMP}/chip_contract_check.log)"
+  exit 1
+fi
+echo "$(date +%T) contract check PASS"
+
 # -- the queue, highest evidence value first -------------------------------
 # bf16 KV cache at eval dtype (f32 activations) vs the f32-cache control:
 # the decode loop is measured HBM-bound on cache reads (gen_ab 2.16x), so

@@ -1,0 +1,343 @@
+#!/usr/bin/env python
+"""Chip-free tracing contract checker: statically assert the invariants the
+generation/training stack otherwise holds only by convention.
+
+Five review rounds' worth of contracts live in comments ("the cache is
+bf16 when the flag is on", "attention accumulates in f32", "pjit shardings
+resolve on every mesh") — this tool turns them into assertions that run in
+seconds on CPU with **zero FLOPs**: everything goes through
+``jax.eval_shape`` / ``jax.make_jaxpr`` / AOT lowering on a virtual
+8-device host mesh, so a dead invariant is caught before it ever reaches
+the chip queue (tools/chip_babysitter.sh runs this ahead of the A/B
+stages).
+
+Checked contracts (see ISSUE 2 / PERF.md "bf16 sliced-KV cache"):
+
+* C1 cache dtype — ``DALLE.prefill`` returns bf16 caches iff
+  ``kv_cache_bf16`` (or the model itself runs bf16); head logits stay f32.
+* C2 f32 accumulation — in the decode jaxpr every dot with a bf16 operand
+  carries ``preferred_element_type=f32`` (the MXU bf16-in/f32-acc mode);
+  applies to f32-activation models, where a bf16 operand can only be the
+  cache.
+* C3 no full-cache f32 materialization — the decode jaxpr contains no
+  bf16->f32 convert of a full-cache-sized array (the XLA hoist that
+  defeated the bf16 cache until PR 1 pinned cache-dtype multiplicands).
+* C4 shardings resolve — for all five parallel strategies (dp, fsdp, tp,
+  sp-ring, sp-ulysses) the strategy's step traces and its shardings
+  lower/partition on a virtual mesh.
+* C5 config variants instantiate — the pallas tile ladder (128/256/512)
+  and both KV-cache dtypes prefill to the expected shapes at the
+  production CUB geometry.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/contract_check.py [--quick]
+
+Exit 0 iff every contract holds.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import os
+
+# Chip-free by construction: an 8-device virtual CPU mesh, forced BEFORE
+# jax initializes a backend — with the axon tunnel plugin pinned and the
+# tunnel down, any device query would otherwise hang (BACKEND001).
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+
+import jax
+
+from dalle_pytorch_tpu.cli import apply_platform_env
+
+apply_platform_env()
+
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu import DALLE, DALLEConfig
+from dalle_pytorch_tpu.models.dalle import decode_codes
+from dalle_pytorch_tpu.parallel.mesh import Partitioner, make_mesh
+from dalle_pytorch_tpu.training import (make_dalle_sp_train_step,
+                                        make_optimizer)
+
+
+class ContractViolation(AssertionError):
+    """A statically-checkable invariant the codebase relies on is broken."""
+
+
+# --- geometries ----------------------------------------------------------
+
+
+def tiny_config(**overrides) -> DALLEConfig:
+    """Small geometry for the strategy checks: seq 24 (divisible by sp=2),
+    heads 4 (divisible by the ulysses sp axis)."""
+    base = dict(dim=32, depth=2, heads=4, dim_head=8, num_text_tokens=50,
+                text_seq_len=8, num_image_tokens=32, image_size=64,
+                image_fmap_size=4)
+    base.update(overrides)
+    return DALLEConfig(**base)
+
+
+def cub_config(**overrides) -> DALLEConfig:
+    """The production CUB-200 geometry (bench.py::cub200_config shapes)."""
+    base = dict(dim=256, depth=8, heads=8, dim_head=64,
+                num_text_tokens=7800, text_seq_len=80,
+                num_image_tokens=1024, image_size=256, image_fmap_size=32)
+    base.update(overrides)
+    return DALLEConfig(**base)
+
+
+# --- shape/jaxpr plumbing ------------------------------------------------
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _init_shapes(dalle: DALLE, batch: int = 2):
+    cfg = dalle.cfg
+    text = _sds((batch, cfg.text_seq_len), jnp.int32)
+    # init with image codes present so the full param tree exists (text-only
+    # forwards never create image_emb)
+    codes = _sds((batch, cfg.image_seq_len), jnp.int32)
+    variables = jax.eval_shape(dalle.init, jax.random.PRNGKey(0), text,
+                               codes)
+    return variables, text
+
+
+def _prefill_shapes(dalle: DALLE, batch: int = 2):
+    variables, text = _init_shapes(dalle, batch)
+    logits, kvs = jax.eval_shape(
+        lambda v, t: dalle.apply(v, t, method=DALLE.prefill), variables, text)
+    return variables, text, logits, kvs
+
+
+def _iter_eqns(jaxpr):
+    """All equations of a jaxpr, recursing into nested jaxprs (pjit bodies,
+    scan/while/cond branches)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for v in vals:
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None:
+                    yield from _iter_eqns(inner)
+                elif hasattr(v, "eqns"):
+                    yield from _iter_eqns(v)
+
+
+def _decode_jaxpr(cfg: DALLEConfig, dalle=None, batch: int = 2):
+    """Jaxpr of the full sampling scan (prefill state -> all image codes) —
+    the program whose HBM traffic the bf16-cache contract governs."""
+    dalle = dalle or DALLE(cfg)
+    variables, _, logits, kvs = _prefill_shapes(dalle, batch)
+    rng = _sds((2,), jnp.uint32)  # raw PRNGKey layout
+
+    def run(v, first_logits, caches, rng):
+        return decode_codes(dalle, v, first_logits, caches, rng)
+
+    return jax.make_jaxpr(run)(variables, logits, kvs, rng), kvs
+
+
+# --- C1: cache/Logits dtype ---------------------------------------------
+
+
+def check_cache_dtype(cfg: DALLEConfig, dalle=None) -> None:
+    """prefill caches are bf16 iff kv_cache_bf16 (or a bf16 model); the
+    logits head output stays f32 regardless."""
+    dalle = dalle or DALLE(cfg)
+    _, _, logits, kvs = _prefill_shapes(dalle)
+    expected = jnp.bfloat16 if (cfg.kv_cache_bf16
+                                or cfg.dtype == jnp.bfloat16) else jnp.float32
+    for i, (k, v) in enumerate(kvs):
+        for name, leaf in (("k", k), ("v", v)):
+            if leaf.dtype != expected:
+                raise ContractViolation(
+                    f"layer {i} cache {name} dtype {leaf.dtype} != "
+                    f"{jnp.dtype(expected).name} (kv_cache_bf16="
+                    f"{cfg.kv_cache_bf16}, dtype={jnp.dtype(cfg.dtype).name})")
+        if k.shape[2] != cfg.seq_len:
+            raise ContractViolation(
+                f"layer {i} cache holds {k.shape[2]} positions, "
+                f"expected seq_len={cfg.seq_len}")
+    if logits.dtype != jnp.float32:
+        raise ContractViolation(
+            f"prefill logits dtype {logits.dtype} != float32 — the head "
+            "must accumulate and emit f32")
+    if logits.shape[-1] != cfg.num_image_tokens:
+        raise ContractViolation(
+            f"prefill logits vocab {logits.shape[-1]} != image vocab "
+            f"{cfg.num_image_tokens}")
+
+
+# --- C2 + C3: decode jaxpr contracts ------------------------------------
+
+
+def check_decode_dots_accumulate_f32(cfg: DALLEConfig, dalle=None) -> None:
+    """Every dot in the decode program with a bf16 operand must state f32
+    accumulation.  Only meaningful for f32-activation models (checkpoint
+    eval dtype): there, a bf16 operand can only be the stored cache."""
+    if cfg.dtype != jnp.float32:
+        raise ValueError("C2 applies to f32-activation configs only")
+    jaxpr, _ = _decode_jaxpr(cfg, dalle)
+    for eqn in _iter_eqns(jaxpr.jaxpr):
+        if eqn.primitive.name != "dot_general":
+            continue
+        if not any(v.aval.dtype == jnp.bfloat16 for v in eqn.invars):
+            continue
+        pref = eqn.params.get("preferred_element_type")
+        if pref is None or jnp.dtype(pref) != jnp.dtype(jnp.float32):
+            raise ContractViolation(
+                f"decode dot_general with bf16 operand accumulates in "
+                f"{pref or 'operand dtype'} (line {eqn.source_info.traceback}"
+                f") — must be preferred_element_type=f32")
+
+
+def check_no_f32_cache_materialization(cfg: DALLEConfig, dalle=None) -> None:
+    """The decode program never converts a full-cache-sized bf16 array to
+    f32 — the hoist that would silently double decode HBM traffic and
+    defeat kv_cache_bf16 (PR 1's measured failure mode)."""
+    jaxpr, kvs = _decode_jaxpr(cfg, dalle)
+    cache_elems = min(int(np.prod(k.shape)) for k, _ in kvs)
+    for eqn in _iter_eqns(jaxpr.jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        (invar,), (outvar,) = eqn.invars, eqn.outvars
+        if getattr(invar, "aval", None) is None:
+            continue
+        if invar.aval.dtype == jnp.bfloat16 \
+                and outvar.aval.dtype == jnp.float32 \
+                and int(np.prod(outvar.aval.shape)) >= cache_elems:
+            raise ContractViolation(
+                f"decode program materializes a full-cache f32 copy: "
+                f"convert_element_type bf16->f32 of shape "
+                f"{outvar.aval.shape} (>= cache size {cache_elems})")
+
+
+# --- C4: parallel strategies --------------------------------------------
+
+# The framework's five parallel strategies (README "Scaling guide"):
+# pure data parallel, ZeRO-style fsdp, tensor parallel, and the two
+# sequence-parallel attention implementations.  pp/ep own separate
+# trainers and are exercised by their own tier-1 tests.
+STRATEGIES = {
+    "dp": dict(mesh=dict(), plan=dict()),
+    "fsdp": dict(mesh=dict(fsdp=4), plan=dict()),
+    "tp": dict(mesh=dict(tp=2), plan=dict()),
+    "sp_ring": dict(mesh=dict(sp=2),
+                    plan=dict(ring_axis="sp", sp_impl="ring", sp_size=2)),
+    "sp_ulysses": dict(mesh=dict(sp=2),
+                       plan=dict(ring_axis="sp", sp_impl="ulysses",
+                                 sp_size=2)),
+}
+
+
+def check_strategy(name: str, make_cfg=tiny_config, batch: int = 8) -> None:
+    """Trace strategy ``name``'s training step on a virtual mesh and prove
+    its shardings resolve — shard_map specs divide, partition rules map
+    every param, and the dense strategies lower AOT under pjit."""
+    spec = STRATEGIES[name]
+    cfg = make_cfg(**spec["plan"])
+    dalle = DALLE(cfg)
+    mesh = make_mesh(**spec["mesh"])
+    variables, text = _init_shapes(dalle, batch)
+    codes = _sds((batch, cfg.image_seq_len), jnp.int32)
+    try:
+        if cfg.ring_axis is not None:
+            tx = make_optimizer(1e-3)
+            step = make_dalle_sp_train_step(dalle, tx, mesh, donate=False)
+            opt = jax.eval_shape(tx.init, variables["params"])
+            jax.eval_shape(step, variables["params"], opt, None, text, codes,
+                           _sds((2,), jnp.uint32))
+        else:
+            pt = Partitioner(mesh=mesh)
+            shardings = pt.param_shardings(variables["params"])
+
+            def loss_fn(p, text, codes):
+                return dalle.apply({"params": p}, text, codes,
+                                   return_loss=True)
+
+            jax.jit(loss_fn,
+                    in_shardings=(shardings, pt.data_sharding,
+                                  pt.data_sharding)).lower(
+                        variables["params"], text, codes).compile()
+    except ContractViolation:
+        raise
+    except Exception as e:
+        raise ContractViolation(
+            f"strategy {name!r} failed to trace/partition on mesh "
+            f"{dict(mesh.shape)}: {type(e).__name__}: {e}") from e
+
+
+# --- C5: config variants ------------------------------------------------
+
+PALLAS_TILES = (128, 256, 512)
+
+
+def check_pallas_variant(block: int, make_cfg=cub_config) -> None:
+    """The pallas tile config instantiates and prefills to the contract
+    shapes (abstract eval only — Mosaic never lowers here)."""
+    cfg = make_cfg(use_pallas=True, pallas_block_q=block,
+                   pallas_block_k=block)
+    check_cache_dtype(cfg)
+
+
+# --- driver --------------------------------------------------------------
+
+
+def run_all(quick: bool = False) -> int:
+    make_cfg = tiny_config if quick else cub_config
+    failures = 0
+
+    def run(label, fn, *args, **kwargs):
+        nonlocal failures
+        try:
+            fn(*args, **kwargs)
+        except ContractViolation as e:
+            failures += 1
+            print(f"FAIL {label}: {e}")
+        else:
+            print(f"PASS {label}")
+
+    for kv_bf16 in (True, False):
+        cfg = make_cfg(kv_cache_bf16=kv_bf16)
+        tag = f"kv_cache_bf16={kv_bf16}"
+        run(f"C1 cache dtype [{tag}]", check_cache_dtype, cfg)
+        run(f"C2 f32 accumulation [{tag}]",
+            check_decode_dots_accumulate_f32, cfg)
+        run(f"C3 no f32 cache materialization [{tag}]",
+            check_no_f32_cache_materialization, cfg)
+    run("C1 cache dtype [dtype=bf16]", check_cache_dtype,
+        make_cfg(dtype=jnp.bfloat16, kv_cache_bf16=False))
+    for name in STRATEGIES:
+        run(f"C4 shardings resolve [{name}]", check_strategy, name)
+    for block in PALLAS_TILES if not quick else PALLAS_TILES[:1]:
+        run(f"C5 pallas tiles [block={block}]", check_pallas_variant, block,
+            make_cfg)
+
+    print(f"\ncontract_check: {'FAIL' if failures else 'PASS'} "
+          f"({failures} violation(s))")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--quick", action="store_true",
+                        help="tiny geometry only (tests/dev smoke)")
+    args = parser.parse_args(argv)
+    return run_all(quick=args.quick)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,7 +24,13 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+from dalle_pytorch_tpu.cli import apply_platform_env  # noqa: E402
 from dalle_pytorch_tpu.utils.failure import Heartbeat  # noqa: E402
+
+# the monitor itself never needs a device, but an accidental backend
+# query downstream must honor JAX_PLATFORMS=cpu instead of hanging on a
+# pinned-but-down tunnel (BACKEND001 contract)
+apply_platform_env()
 
 
 def scan(directory: Path, timeout: float, expect: int | None) -> int:
@@ -51,6 +57,7 @@ def scan(directory: Path, timeout: float, expect: int | None) -> int:
             done = bool(info.get("done"))
             age = now - info["time"]
             detail = f"step {info.get('step', '?')} age {age:.0f}s"
+        # graftlint: disable=EXC001 (a heartbeat mid-write is expected; any parse error = torn file, reported as status below)
         except Exception:
             detail = "unreadable (torn write?)"
         # a finished run's heartbeat ages forever — that's completion, not

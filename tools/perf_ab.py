@@ -118,8 +118,10 @@ def main(argv=None) -> int:
                      "measurement slot; use --reps for repeated measurement")
 
     import bench
-    from dalle_pytorch_tpu.cli import enable_compilation_cache
+    from dalle_pytorch_tpu.cli import (apply_platform_env,
+                                      enable_compilation_cache)
 
+    apply_platform_env()  # JAX_PLATFORMS=cpu wins over the tunnel pin
     enable_compilation_cache()  # variant recompiles across runs hit the cache
 
     measures = {}

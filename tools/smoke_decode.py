@@ -84,6 +84,12 @@ def smoke_clip(path: Path, outdir: Path):
 
 
 def main(argv=None):
+    # honor JAX_PLATFORMS=cpu over the sitecustomize-pinned tunnel plugin
+    # BEFORE the smoke decodes touch a backend (BACKEND001 contract —
+    # same order tools/chip_equiv.py uses)
+    from dalle_pytorch_tpu.cli import apply_platform_env
+
+    apply_platform_env()
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--dir", required=True,
                         help="directory holding the converted *.msgpack")

@@ -198,6 +198,7 @@ def main(argv=None):
     )
     import json as _json
     import os as _os
+    # graftlint: disable=ENV001 (JSON-valued: presence of any override dict is the signal)
     if _os.environ.get('DALLE_TPU_HPARAMS'):
         C.update(_json.loads(_os.environ['DALLE_TPU_HPARAMS']))
 
