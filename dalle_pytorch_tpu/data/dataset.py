@@ -15,10 +15,13 @@ replaces torch's ``DistributedSampler`` (`train_dalle.py:261-269`).
 """
 from __future__ import annotations
 
+import threading
 from pathlib import Path
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
+
+from ..utils import faults
 
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
 
@@ -26,6 +29,9 @@ IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
 def _load_image(path: Path):
     from PIL import Image
 
+    # faultpoint: GRAFT_FAULTS="sample_read:every=K" makes every K-th read
+    # raise, rehearsing the retry/quarantine degradation path below
+    faults.fire("sample_read")
     img = Image.open(path)
     img.load()  # force the decode now — PIL is lazy, and the dataset's
     # skip-bad-sample handler must see truncated-file errors here
@@ -123,6 +129,13 @@ class TextImageDataset:
         self.truncate_captions = truncate_captions
         self.seed = seed
         self.epoch = 0  # set by the DataLoader each epoch (set_epoch)
+        # graceful degradation: samples whose reads keep failing are
+        # quarantined (skipped for the rest of the run) instead of killing
+        # a pod-scale job over one unreadable JPEG — but a *rotten* dataset
+        # must still fail loudly, so the quarantine is capped.
+        self._quarantined: set = set()
+        self._quarantine_lock = threading.Lock()
+        self.max_quarantine = max(8, len(keys) // 20)
 
     def __len__(self):
         return len(self.keys)
@@ -137,6 +150,37 @@ class TextImageDataset:
     def __getitem__(self, idx: int):
         return self.item(idx, self.epoch)
 
+    def _quarantine(self, key: str, err: Exception) -> None:
+        """Mark a sample as unreadable for the rest of the run (logged,
+        capped).  Raises once the cap trips: a run skipping >5% of its data
+        is training on a different dataset and must fail loudly."""
+        with self._quarantine_lock:
+            self._quarantined.add(key)
+            n = len(self._quarantined)
+        print(f"warning: quarantining sample {key} "
+              f"({n}/{self.max_quarantine} quarantined): {err}", flush=True)
+        if n > self.max_quarantine:
+            raise RuntimeError(
+                f"TextImageDataset: {n} samples quarantined (cap "
+                f"{self.max_quarantine}) — the dataset folder is rotten, "
+                "refusing to silently train on what is left")
+
+    def _read_sample(self, key: str, rng):
+        descriptions = [
+            line for line in self.text_files[key].read_text().split("\n")
+            if line.strip()
+        ]
+        if not descriptions:
+            raise ValueError(f"empty caption file {self.text_files[key]}")
+        description = descriptions[int(rng.integers(len(descriptions)))]
+        tokens = self.tokenizer.tokenize(
+            description, self.text_len, truncate_text=self.truncate_captions
+        )[0]
+        img = _load_image(self.image_files[key])
+        arr = random_resized_crop(img, self.image_size, rng,
+                                  scale=(self.resize_ratio, 1.0))
+        return tokens, arr
+
     def item(self, idx: int, epoch: int):
         # fresh per-call Generator: numpy Generators are not thread-safe and
         # this runs concurrently under the prefetching DataLoader.  Seeding
@@ -145,28 +189,22 @@ class TextImageDataset:
         # (a shared draw counter would depend on both).
         rng = np.random.default_rng((self.seed, idx, epoch))
 
-        # skip-bad-sample resilience: walk to a neighboring index rather than
-        # aborting the epoch on one corrupt image / empty caption.
+        # graceful degradation: retry the sample once (transient I/O — NFS
+        # blips, injected faults — usually passes on the second read), then
+        # quarantine it and walk to a neighboring index rather than aborting
+        # the epoch on one corrupt image / empty caption.
         max_attempts = min(len(self), 16)
         for attempt in range(max_attempts):
             key = self.keys[(idx + attempt) % len(self)]
-            try:
-                descriptions = [
-                    line for line in self.text_files[key].read_text().split("\n")
-                    if line.strip()
-                ]
-                if not descriptions:
-                    raise ValueError(f"empty caption file {self.text_files[key]}")
-                description = descriptions[int(rng.integers(len(descriptions)))]
-                tokens = self.tokenizer.tokenize(
-                    description, self.text_len, truncate_text=self.truncate_captions
-                )[0]
-                img = _load_image(self.image_files[key])
-                arr = random_resized_crop(img, self.image_size, rng,
-                                          scale=(self.resize_ratio, 1.0))
-                return tokens, arr
-            except (OSError, ValueError) as e:
-                print(f"warning: skipping sample {key}: {e}", flush=True)
+            if key in self._quarantined:
+                continue
+            last_err = None
+            for retry in range(2):
+                try:
+                    return self._read_sample(key, rng)
+                except (OSError, ValueError) as e:
+                    last_err = e
+            self._quarantine(key, last_err)
         raise RuntimeError(
             f"TextImageDataset: {max_attempts} consecutive samples failed to "
             f"load starting at index {idx} — check the dataset folder")
@@ -194,6 +232,35 @@ class DataLoader:
         self.shard_index = shard_index
         self.num_workers = num_workers
         self.prefetch = prefetch
+        self._iter_epoch = 0   # epoch of the in-flight iterator
+        self._cursor = 0       # batches delivered this epoch (incl. skipped)
+        self._skip = 0         # batches to skip at the next __iter__ (resume)
+
+    # --- exact mid-epoch resume ------------------------------------------
+
+    def state_dict(self) -> dict:
+        """Position snapshot for exact resume: (seed, epoch, cursor) pin the
+        permutation and the batch inside it, so a run killed at step N
+        restarts at step N+1 with the same sample order — the loader is
+        seeded-deterministic, so three ints are the whole state."""
+        return {"seed": int(self.seed), "epoch": int(self._iter_epoch),
+                "cursor": int(self._cursor)}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a :meth:`state_dict`: the next ``__iter__`` replays the
+        recorded epoch's permutation and skips the already-consumed
+        batches.  A cursor at the epoch boundary (``cursor == len(self)``)
+        yields an empty epoch — the trainer replays its epoch-end
+        bookkeeping (scheduler step) exactly once and moves on, which is
+        what a checkpoint written after the last batch but before the
+        epoch-end step requires."""
+        self.seed = int(state.get("seed", self.seed))
+        epoch = int(state.get("epoch", 0))
+        cursor = int(state.get("cursor", 0))
+        self.epoch = epoch
+        self._iter_epoch = epoch
+        self._cursor = cursor
+        self._skip = cursor
 
     def __len__(self):
         n = len(self.ds) // self.shard_num_hosts
@@ -223,6 +290,7 @@ class DataLoader:
         indices = self._epoch_indices()
         epoch = self.epoch
         self.epoch += 1
+        self._iter_epoch = epoch
         batches = [
             indices[i : i + self.batch_size]
             for i in range(0, len(indices) - self.batch_size + 1, self.batch_size)
@@ -230,12 +298,24 @@ class DataLoader:
         if not self.drop_last and len(indices) % self.batch_size:
             batches.append(indices[-(len(indices) % self.batch_size):])
 
-        if self.num_workers <= 0:
-            for b in batches:
-                yield self._collate([self._fetch(i, epoch) for i in b])
-            return
+        # resume skip: drop the batches a restored run already consumed;
+        # _cursor keeps counting from the skip offset so a checkpoint taken
+        # mid-epoch records the TRUE position in the permutation
+        skip, self._skip = self._skip, 0
+        self._cursor = skip
+        batches = batches[skip:]
 
-        yield from self._prefetch_iter(batches, epoch)
+        if self.num_workers <= 0:
+            inner = (self._collate([self._fetch(i, epoch) for i in b])
+                     for b in batches)
+        else:
+            inner = self._prefetch_iter(batches, epoch)
+        for batch in inner:
+            # incremented BEFORE the yield: while the train loop holds batch
+            # k, state_dict() reports cursor k+1 — exactly the batches a
+            # checkpoint written after this step must skip on resume
+            self._cursor += 1
+            yield batch
 
     def _collate(self, items):
         from . import native

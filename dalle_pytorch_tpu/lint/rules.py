@@ -285,6 +285,71 @@ def rule_exc001(ctx: FileCtx) -> Iterator[RuleHit]:
         yield node, msg.format(label)
 
 
+# --- CKPT001: raw durable-state writes outside the atomic helpers --------
+
+_CKPT_TOKENS = ("ckpt", "checkpoint", "heartbeat", "manifest")
+_WRITE_MODE_CHARS = "wax"
+
+
+def _literal_mode(call: ast.Call, pos: int) -> str:
+    """The mode string of an open()-style call, '' if absent/non-literal.
+    ``pos`` is the mode's positional index: 1 for builtin ``open(file,
+    mode)``, 0 for ``Path.open(mode)``."""
+    mode = None
+    if len(call.args) > pos:
+        mode = call.args[pos]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return ""
+
+
+def rule_ckpt001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """Durable run state (checkpoints, heartbeats, manifests) written with
+    a raw ``open(..., "wb")`` / ``write_text`` can be torn by a crash or
+    preemption mid-write — and a torn checkpoint is exactly the failure
+    the crash-consistency layer exists to survive.  Every durable-state
+    write must go through the atomic-rename helpers in ``utils/``
+    (``save_checkpoint``, ``CheckpointManager``, ``Heartbeat._write``:
+    temp file + fsync + ``os.replace``), which are themselves exempt.
+    Syntactic over-approximation: any write-mode open / ``write_text`` /
+    ``write_bytes`` whose target expression mentions a checkpoint-ish
+    token; pragma with a justification where the write is provably not
+    durable state (or already renamed into place)."""
+    msg = ("raw {} to a checkpoint/heartbeat/manifest path can be torn by "
+           "a crash mid-write; route durable-state writes through the "
+           "atomic-rename helpers in dalle_pytorch_tpu/utils "
+           "(save_checkpoint / CheckpointManager / Heartbeat), or pragma "
+           "with why this write is not durable state")
+    parts = ctx.path.replace("\\", "/").split("/")
+    if "utils" in parts:  # the atomic helpers live here
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = None
+        label = None
+        if isinstance(node.func, ast.Name) and node.func.id == "open" \
+                and node.args:
+            mode = _literal_mode(node, 1)
+            if any(c in mode for c in _WRITE_MODE_CHARS):
+                target = ast.unparse(node.args[0])
+                label = f'open(..., "{mode}")'
+        elif isinstance(node.func, ast.Attribute):
+            if node.func.attr == "open":
+                mode = _literal_mode(node, 0)
+                if any(c in mode for c in _WRITE_MODE_CHARS):
+                    target = ast.unparse(node.func.value)
+                    label = f'.open("{mode}")'
+            elif node.func.attr in ("write_text", "write_bytes"):
+                target = ast.unparse(node.func.value)
+                label = f".{node.func.attr}()"
+        if target and any(tok in target.lower() for tok in _CKPT_TOKENS):
+            yield node, msg.format(label)
+
+
 RULES = {
     "ENV001": rule_env001,
     "SEED001": rule_seed001,
@@ -292,4 +357,5 @@ RULES = {
     "DOT001": rule_dot001,
     "TRACE001": rule_trace001,
     "EXC001": rule_exc001,
+    "CKPT001": rule_ckpt001,
 }

@@ -31,8 +31,14 @@ def _to_numpy(tree):
     return tree
 
 
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed to deserialize — truncated or corrupt."""
+
+
 def save_checkpoint(path: str | Path, obj: dict) -> None:
-    """Atomically write `obj` (a pytree of arrays + plain python) to `path`."""
+    """Atomically write `obj` (a pytree of arrays + plain python) to `path`.
+    The temp file is fsynced before the rename so a crash right after the
+    publish cannot leave a renamed-but-empty file behind."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     data = serialization.msgpack_serialize(_to_numpy(obj))
@@ -40,6 +46,8 @@ def save_checkpoint(path: str | Path, obj: dict) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -49,11 +57,22 @@ def save_checkpoint(path: str | Path, obj: dict) -> None:
 def load_checkpoint(path: str | Path) -> Any:
     """Load either checkpoint format: a msgpack file, or (when `path` is a
     directory) an Orbax sharded checkpoint — so every CLI load site accepts
-    both transparently."""
+    both transparently.  A file that fails to deserialize (truncated by a
+    kill mid-write, or corrupt) raises :class:`CheckpointCorruptError`
+    naming the file and its size instead of a bare msgpack unpack error."""
     if is_sharded_checkpoint(path):
         return load_checkpoint_sharded(path)
     with open(path, "rb") as f:
-        return serialization.msgpack_restore(f.read())
+        data = f.read()
+    try:
+        return serialization.msgpack_restore(data)
+    except Exception as e:  # msgpack raises several unpack error classes
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is corrupt or truncated ({len(data)} bytes): "
+            f"{e}.  If this run keeps managed checkpoints (a --ckpt_dir with "
+            "manifests), resume with --resume auto — "
+            "CheckpointManager.latest_valid() skips corrupt checkpoints and "
+            "falls back to the previous good one.") from e
 
 
 def is_process_zero() -> bool:

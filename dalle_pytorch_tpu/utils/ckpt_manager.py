@@ -1,0 +1,293 @@
+"""Crash-consistent checkpoint management: manifests, fallback, retention.
+
+The single-file atomic saves (``utils/checkpoint.py``) make one checkpoint
+crash-consistent; this layer makes a *run directory* of them
+crash-consistent.  A preemptible-pod run dies mid-write, resumes from
+storage that bit-rots, and must never lose more than one checkpoint
+interval — so every checkpoint gets an integrity manifest, resume scans for
+the newest checkpoint that *verifies* (falling back past torn or corrupt
+ones), and saves retry transient I/O errors with exponential backoff.
+
+Layout (one directory per checkpoint)::
+
+    run_dir/
+      ckpt-00000004/
+        data.msgpack            # or data.orbax/ (sharded saves)
+        manifest.json           # published LAST, by atomic rename
+      ckpt-00000007/ ...
+
+The manifest is the commit record: it is written (atomically) only after
+the payload bytes are on disk, so a directory without a valid manifest is
+by definition a torn write and :meth:`CheckpointManager.latest_valid`
+skips it.  Manifest fields: ``schema`` (payload schema version), ``step``,
+``config_fingerprint`` (crc32 of the canonical config JSON — resuming a
+*different* model silently is its own bug class), ``payload`` (the data
+file/dir name), ``files`` (per-file size + crc32, verified on scan), and
+``time``.
+
+Fault injection (``GRAFT_FAULTS``, see ``utils/faults.py``) threads through
+``save`` at the ``ckpt_write`` site so the retry and fallback paths are
+rehearsed by tests instead of discovered by the first real preemption.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import time
+import zlib
+from pathlib import Path
+from typing import Optional
+
+from . import faults
+from .checkpoint import (is_process_zero, save_checkpoint,
+                         save_checkpoint_sharded)
+
+SCHEMA_VERSION = 1
+MANIFEST = "manifest.json"
+_DIR_RE = re.compile(r"^(?P<prefix>.+)-(?P<step>\d{8})$")
+
+
+def config_fingerprint(cfg: Optional[dict]) -> Optional[str]:
+    """crc32 of the canonical JSON of a config dict — cheap identity check
+    so ``latest_valid`` can refuse checkpoints of a different model."""
+    if cfg is None:
+        return None
+    blob = json.dumps(cfg, sort_keys=True, default=str).encode()
+    return f"{zlib.crc32(blob):08x}"
+
+
+def file_crc32(path: Path, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
+
+
+def _tree_crc(root: Path) -> dict:
+    """relpath -> {size, crc32} for every file under ``root`` except the
+    manifest itself (orbax payloads are directories of shard files)."""
+    out = {}
+    for p in sorted(root.rglob("*")):
+        if p.is_file() and p.name != MANIFEST:
+            rel = str(p.relative_to(root))
+            out[rel] = {"size": p.stat().st_size,
+                        "crc32": f"{file_crc32(p):08x}"}
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointInfo:
+    """One verified checkpoint: pass ``payload`` to ``load_checkpoint``
+    (a msgpack file or an Orbax directory — load sites accept both)."""
+
+    directory: Path
+    payload: Path
+    step: int
+    manifest: dict
+
+
+def verify(directory: Path,
+           fingerprint: Optional[str] = None) -> Optional[CheckpointInfo]:
+    """Integrity-check one checkpoint directory: manifest present and
+    parseable, schema known, payload present, every listed file matching
+    its recorded size and crc32.  Returns None (with a stderr note saying
+    why) instead of raising — a corrupt checkpoint is a *skip*, not a
+    crash, on the resume path."""
+    directory = Path(directory)
+    if not directory.is_dir():
+        return None  # nothing there at all — silent (save()'s pre-check)
+    mpath = directory / MANIFEST
+
+    def bad(why: str) -> None:
+        print(f"[ckpt] skipping {directory.name}: {why}",
+              file=sys.stderr, flush=True)
+
+    if not mpath.is_file():
+        bad("no manifest (torn write — the save died before publishing)")
+        return None
+    try:
+        manifest = json.loads(mpath.read_text())
+    except (json.JSONDecodeError, OSError) as e:
+        bad(f"unreadable manifest ({e})")
+        return None
+    if manifest.get("schema", 0) > SCHEMA_VERSION:
+        bad(f"manifest schema {manifest.get('schema')} is newer than this "
+            f"build's {SCHEMA_VERSION}")
+        return None
+    if fingerprint is not None and manifest.get("config_fingerprint") \
+            not in (None, fingerprint):
+        bad(f"config fingerprint {manifest.get('config_fingerprint')} != "
+            f"this run's {fingerprint} (a different model)")
+        return None
+    payload = directory / manifest.get("payload", "")
+    if not payload.exists():
+        bad(f"payload {manifest.get('payload')!r} missing")
+        return None
+    for rel, meta in manifest.get("files", {}).items():
+        f = directory / rel
+        if not f.is_file():
+            bad(f"listed file {rel} missing")
+            return None
+        size = f.stat().st_size
+        if size != meta.get("size"):
+            bad(f"{rel} is {size} bytes, manifest says {meta.get('size')} "
+                "(truncated?)")
+            return None
+        if f"{file_crc32(f):08x}" != meta.get("crc32"):
+            bad(f"{rel} fails its crc32 (corrupt)")
+            return None
+    return CheckpointInfo(directory=directory, payload=payload,
+                          step=int(manifest.get("step", 0)),
+                          manifest=manifest)
+
+
+class CheckpointManager:
+    """Manifest-publishing writer + validity-scanning reader over the
+    existing msgpack/Orbax checkpoint formats.
+
+    Single-writer semantics for msgpack payloads (call ``save`` on process
+    0 only, with host arrays — same contract as ``save_checkpoint``);
+    sharded saves are collective (every process calls ``save``, only
+    process 0 publishes the manifest and applies retention).
+    """
+
+    def __init__(self, run_dir, prefix: str = "ckpt", keep_last: int = 3,
+                 keep_every: int = 0, retries: int = 3,
+                 backoff: float = 0.25, sharded: bool = False,
+                 fingerprint: Optional[str] = None):
+        self.run_dir = Path(run_dir)
+        self.prefix = prefix
+        self.keep_last = int(keep_last)
+        self.keep_every = int(keep_every)
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+        self.sharded = bool(sharded)
+        self.fingerprint = fingerprint
+
+    # --- paths ---
+
+    def _dir_for(self, step: int) -> Path:
+        return self.run_dir / f"{self.prefix}-{int(step):08d}"
+
+    def _all_dirs(self):
+        """(step, path) for every checkpoint-shaped dir, newest first."""
+        if not self.run_dir.is_dir():
+            return []
+        out = []
+        for p in self.run_dir.iterdir():
+            m = _DIR_RE.match(p.name)
+            if p.is_dir() and m and m.group("prefix") == self.prefix:
+                out.append((int(m.group("step")), p))
+        return sorted(out, reverse=True)
+
+    # --- write side ---
+
+    def save(self, step: int, payload: dict) -> Path:
+        """Write checkpoint ``step``; returns the payload path.  Transient
+        ``OSError``s (including injected ones) retry with exponential
+        backoff; a step that already has a *valid* manifest is a no-op (the
+        interrupt path may land on a step the cadence just saved)."""
+        existing = verify(self._dir_for(step))
+        if existing is not None:
+            return existing.payload
+        for attempt in range(self.retries + 1):
+            try:
+                return self._save_once(step, payload)
+            except OSError as e:
+                if attempt >= self.retries:
+                    raise
+                delay = self.backoff * (2 ** attempt)
+                print(f"[ckpt] save step {step} attempt {attempt + 1} "
+                      f"failed ({e}); retrying in {delay:.2f}s",
+                      file=sys.stderr, flush=True)
+                time.sleep(delay)
+        raise AssertionError("unreachable")
+
+    def _save_once(self, step: int, payload: dict) -> Path:
+        actions = faults.fire("ckpt_write")
+        cdir = self._dir_for(step)
+        if cdir.exists() and not (cdir / MANIFEST).exists():
+            # partial leftovers of a previous failed attempt: start clean
+            shutil.rmtree(cdir, ignore_errors=True)
+        cdir.mkdir(parents=True, exist_ok=True)
+        if self.sharded:
+            data = cdir / "data.orbax"
+            save_checkpoint_sharded(data, payload)
+        else:
+            data = cdir / "data.msgpack"
+            save_checkpoint(data, payload)
+        if self.sharded and not is_process_zero():
+            return data  # manifest + retention are single-writer
+        files = _tree_crc(cdir)
+        if "truncate" in actions:
+            # chaos: tear the payload AFTER the CRCs were computed — models
+            # a crash/bit-rot between the data landing and the next read.
+            # The manifest still publishes, so only CRC verification can
+            # catch it (exactly what latest_valid must survive).
+            victim = data if data.is_file() else next(
+                p for p in sorted(data.rglob("*")) if p.is_file())
+            os.truncate(victim, max(victim.stat().st_size // 2, 1))
+        manifest = {"schema": SCHEMA_VERSION, "step": int(step),
+                    "config_fingerprint": self.fingerprint,
+                    "payload": data.name, "files": files,
+                    "time": time.time()}
+        self._publish_manifest(cdir, manifest)
+        self._apply_retention()
+        return data
+
+    @staticmethod
+    def _publish_manifest(cdir: Path, manifest: dict) -> None:
+        """Atomic-rename publish, fsynced: the manifest IS the commit
+        record, so it must never itself be readable half-written."""
+        fd, tmp = tempfile.mkstemp(dir=str(cdir), prefix=".manifest-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(manifest, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, cdir / MANIFEST)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def _apply_retention(self) -> None:
+        """keep-last-N + keep-every-M: after a successful save, delete
+        checkpoints that are neither among the ``keep_last`` newest steps
+        nor multiples of ``keep_every``.  ``keep_last <= 0`` keeps
+        everything."""
+        if self.keep_last <= 0:
+            return
+        dirs = self._all_dirs()  # newest first
+        keep = {step for step, _ in dirs[:self.keep_last]}
+        if self.keep_every > 0:
+            keep |= {step for step, _ in dirs
+                     if step % self.keep_every == 0}
+        for step, path in dirs:
+            if step not in keep:
+                shutil.rmtree(path, ignore_errors=True)
+
+    # --- read side ---
+
+    def latest_valid(self) -> Optional[CheckpointInfo]:
+        """The newest checkpoint that passes integrity verification,
+        scanning past (and reporting) torn or corrupt ones."""
+        for _step, path in self._all_dirs():
+            info = verify(path, fingerprint=self.fingerprint)
+            if info is not None:
+                return info
+        return None
+
+
+def latest_valid(run_dir, prefix: str = "ckpt",
+                 fingerprint: Optional[str] = None) -> Optional[CheckpointInfo]:
+    """Module-level convenience for external monitors (tools/monitor.py)."""
+    return CheckpointManager(run_dir, prefix=prefix,
+                             fingerprint=fingerprint).latest_valid()

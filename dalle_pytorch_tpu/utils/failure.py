@@ -133,6 +133,7 @@ class Heartbeat:
         self.dir.mkdir(parents=True, exist_ok=True)
         self.path = self.dir / f"heartbeat-p{jax.process_index()}.json"
         self.beat_interval = float(beat_interval)
+        self._sweep_stale_temps()
         # None until the first beat: the stretch from construction to step 1
         # includes the XLA compile (minutes at real sizes), which must not
         # read as a stall
@@ -164,6 +165,20 @@ class Heartbeat:
         self._last_write = now
         self._write({"step": int(step), "time": time.time(),
                      "process": jax.process_index(), **extra})
+
+    def _sweep_stale_temps(self) -> None:
+        """A process killed inside ``_write`` (between mkstemp and the
+        rename) leaks one ``.hb-*`` temp file; over many preemption cycles
+        a long-lived heartbeat dir fills with them.  On startup, remove
+        temps older than a few beat intervals — anything that old cannot
+        belong to a write still in flight."""
+        cutoff = time.time() - 3 * self.beat_interval
+        for tmp in self.dir.glob(".hb-*"):
+            try:
+                if tmp.stat().st_mtime < cutoff:
+                    tmp.unlink()
+            except OSError:  # racing another process's write or sweep
+                pass
 
     def _write(self, payload: dict) -> None:
         fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".hb-")

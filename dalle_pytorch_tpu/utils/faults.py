@@ -1,0 +1,174 @@
+"""Fault injection: a faultpoint registry driven by the ``GRAFT_FAULTS`` env.
+
+The recovery paths this repo grew for preemptible pods (graceful shutdown,
+manifest-validated checkpoints, quarantined samples) are exactly the code
+nobody runs until a 3am preemption does — the untested-recovery failure
+mode production checkpoint managers are built to close.  This module makes
+the failures injectable so tests and the CI ``crash-resume`` job can rehearse
+them deterministically on CPU:
+
+    GRAFT_FAULTS="ckpt_write:fail_after=2,ckpt_write:truncate=3,\
+sigterm:at_step=7,sample_read:every=50"
+
+Grammar: comma-separated ``site:action=value`` entries.  Sites are named
+call-points threaded through the real code (``ckpt_write`` in
+``CheckpointManager.save``, ``sample_read`` in the dataset image/caption
+reads, ``sigterm`` in the trainers' step loops).  Actions:
+
+* ``fail_after=N`` — the (N+1)-th hit of the site raises
+  :class:`InjectedFault` (an ``OSError``), once.  Exercises retry paths:
+  the first N calls succeed, one fails, the retry lands.
+* ``every=K`` — every K-th hit raises :class:`InjectedFault`.  Exercises
+  degradation paths (sample quarantine) and retry exhaustion (``every=1``).
+* ``truncate=N`` — the N-th hit returns the ``"truncate"`` action to the
+  caller, once; the caller tears its own write (``CheckpointManager``
+  halves the payload file *after* the manifest CRCs were computed —
+  modeling a crash or bit-rot between the data landing and the next read).
+* ``at_step=N`` — fires once when the caller passes ``step == N``;
+  :func:`maybe_kill` turns it into a real ``SIGTERM`` to this process
+  (the preemption notice, mid-training).
+
+Counters are per-site and thread-safe (dataset reads run under the
+prefetching DataLoader's thread pool).  The registry is parsed lazily from
+the environment; trainers call :func:`install_from_env` at startup so
+in-process reruns (tests call ``main()`` repeatedly) see the *current*
+environment, not a cached one.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+from typing import Dict, FrozenSet, List, Optional
+
+_ACTIONS = ("fail_after", "every", "truncate", "at_step")
+
+
+class InjectedFault(OSError):
+    """A deliberately injected transient I/O failure (``GRAFT_FAULTS``)."""
+
+
+@dataclasses.dataclass
+class _Trigger:
+    action: str
+    value: int
+    fired: bool = False
+
+
+class FaultRegistry:
+    """Parsed ``GRAFT_FAULTS`` spec + per-site hit counters."""
+
+    def __init__(self, spec: str = ""):
+        self._lock = threading.Lock()
+        self._triggers: Dict[str, List[_Trigger]] = {}
+        self._hits: Dict[str, int] = {}
+        for entry in (e.strip() for e in (spec or "").split(",")):
+            if not entry:
+                continue
+            site, sep, act = entry.partition(":")
+            action, sep2, value = act.partition("=")
+            if not sep or not sep2 or not site or action not in _ACTIONS:
+                raise ValueError(
+                    f"bad GRAFT_FAULTS entry {entry!r}: expected "
+                    f"'site:action=value' with action in {_ACTIONS}")
+            try:
+                ivalue = int(value)
+            except ValueError as e:
+                raise ValueError(
+                    f"bad GRAFT_FAULTS value in {entry!r}: {value!r} is not "
+                    "an integer") from e
+            if ivalue < 0:
+                raise ValueError(f"bad GRAFT_FAULTS value in {entry!r}: "
+                                 "must be >= 0")
+            self._triggers.setdefault(site, []).append(
+                _Trigger(action, ivalue))
+
+    @property
+    def empty(self) -> bool:
+        return not self._triggers
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def fire(self, site: str, step: Optional[int] = None) -> FrozenSet[str]:
+        """Register one hit of ``site``; raise or return triggered actions.
+
+        ``fail_after``/``every`` raise :class:`InjectedFault`;
+        ``truncate``/``at_step`` are returned for the caller to act on.
+        """
+        with self._lock:
+            hits = self._hits[site] = self._hits.get(site, 0) + 1
+            actions = set()
+            for t in self._triggers.get(site, ()):
+                if t.action == "fail_after":
+                    if not t.fired and hits == t.value + 1:
+                        t.fired = True
+                        raise InjectedFault(
+                            f"injected fault: {site} hit {hits} "
+                            f"(fail_after={t.value})")
+                elif t.action == "every":
+                    if t.value > 0 and hits % t.value == 0:
+                        raise InjectedFault(
+                            f"injected fault: {site} hit {hits} "
+                            f"(every={t.value})")
+                elif t.action == "truncate":
+                    if not t.fired and hits == t.value:
+                        t.fired = True
+                        actions.add("truncate")
+                elif t.action == "at_step":
+                    if not t.fired and step is not None and step == t.value:
+                        t.fired = True
+                        actions.add("at_step")
+            return frozenset(actions)
+
+
+_registry: Optional[FaultRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def install(spec: str) -> FaultRegistry:
+    """Install an explicit spec (tests); returns the registry."""
+    global _registry
+    with _registry_lock:
+        _registry = FaultRegistry(spec)
+        return _registry
+
+
+def install_from_env() -> FaultRegistry:
+    """(Re-)parse ``GRAFT_FAULTS``.  Trainers call this at startup so
+    in-process reruns pick up the current environment, not a stale cache."""
+    return install(os.environ.get("GRAFT_FAULTS", ""))
+
+
+def reset() -> None:
+    """Drop the registry; the next :func:`fire` re-reads the environment."""
+    global _registry
+    with _registry_lock:
+        _registry = None
+
+
+def get_registry() -> FaultRegistry:
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            _registry = FaultRegistry(os.environ.get("GRAFT_FAULTS", ""))
+        return _registry
+
+
+def fire(site: str, step: Optional[int] = None) -> FrozenSet[str]:
+    """Hit a faultpoint.  No-op (empty set) when no faults are configured —
+    cheap enough to leave in hot-ish paths like the dataset read."""
+    reg = get_registry()
+    if reg.empty:
+        return frozenset()
+    return reg.fire(site, step=step)
+
+
+def maybe_kill(step: int) -> None:
+    """The ``sigterm:at_step=N`` site: deliver a real SIGTERM to this
+    process at step N — the preemption notice, so GracefulShutdown's
+    checkpoint-and-stop path is rehearsed end to end."""
+    if "at_step" in fire("sigterm", step=step):
+        signal.raise_signal(signal.SIGTERM)

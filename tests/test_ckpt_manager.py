@@ -1,0 +1,185 @@
+"""CheckpointManager: manifests, latest_valid fallback, retention, retrying
+I/O — the crash-consistency layer over the msgpack/Orbax writers.
+
+Every failure mode here is one the resume path must SURVIVE, not crash on:
+a torn payload behind a published manifest (bit rot / crash between the
+data landing and the read), a dir with no manifest (killed before
+publish), a corrupt manifest, a checkpoint from a different model config.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.utils import faults
+from dalle_pytorch_tpu.utils.ckpt_manager import (MANIFEST, CheckpointManager,
+                                                  config_fingerprint,
+                                                  latest_valid, verify)
+from dalle_pytorch_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                                load_checkpoint)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+
+
+def payload(step):
+    return {"weights": {"w": np.full((4, 3), float(step), np.float32)},
+            "epoch": step // 10, "global_step": step}
+
+
+def test_save_publishes_manifest_and_roundtrips(tmp_path):
+    mgr = CheckpointManager(tmp_path, fingerprint="abc")
+    data = mgr.save(7, payload(7))
+    manifest = json.loads((data.parent / MANIFEST).read_text())
+    assert manifest["step"] == 7
+    assert manifest["config_fingerprint"] == "abc"
+    assert manifest["payload"] == "data.msgpack"
+    assert "data.msgpack" in manifest["files"]
+    assert len(manifest["files"]["data.msgpack"]["crc32"]) == 8
+
+    info = mgr.latest_valid()
+    assert info is not None and info.step == 7
+    back = load_checkpoint(info.payload)
+    np.testing.assert_array_equal(back["weights"]["w"],
+                                  payload(7)["weights"]["w"])
+    assert int(back["global_step"]) == 7
+
+
+def test_latest_valid_falls_back_past_torn_payload(tmp_path, capsys):
+    """The tentpole scenario: the NEWEST checkpoint's payload is truncated
+    (crash mid-write / bit rot behind a published manifest) — resume must
+    fall back to the previous good one, reporting the skip."""
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(4, payload(4))
+    data7 = mgr.save(7, payload(7))
+    data7.write_bytes(data7.read_bytes()[: data7.stat().st_size // 2])
+
+    info = mgr.latest_valid()
+    assert info is not None and info.step == 4
+    err = capsys.readouterr().err
+    assert "skipping ckpt-00000007" in err and "truncated" in err
+    # and the truncated payload itself raises a CLEAR error if loaded raw
+    with pytest.raises(CheckpointCorruptError) as e:
+        load_checkpoint(data7)
+    assert "data.msgpack" in str(e.value) and "bytes" in str(e.value)
+    assert "latest_valid" in str(e.value)
+
+
+def test_latest_valid_skips_unpublished_and_corrupt_manifest(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, payload(1))
+    # killed between data write and manifest publish: dir, data, no manifest
+    torn = tmp_path / "ckpt-00000005"
+    torn.mkdir()
+    (torn / "data.msgpack").write_bytes(b"partial")
+    # corrupt manifest json
+    bad = tmp_path / "ckpt-00000006"
+    bad.mkdir()
+    (bad / "data.msgpack").write_bytes(b"x")
+    (bad / MANIFEST).write_text("{not json")
+
+    info = mgr.latest_valid()
+    assert info is not None and info.step == 1
+    assert verify(torn) is None and verify(bad) is None
+
+
+def test_latest_valid_empty_and_missing_dir(tmp_path):
+    assert CheckpointManager(tmp_path / "nope").latest_valid() is None
+    assert latest_valid(tmp_path) is None
+
+
+def test_config_fingerprint_guard(tmp_path):
+    """A checkpoint of a DIFFERENT model config must not be silently
+    resumed; a fingerprint-less scan (auto-resume before the config is
+    known) still accepts it."""
+    CheckpointManager(tmp_path, fingerprint=config_fingerprint(
+        {"dim": 64})).save(3, payload(3))
+    other = CheckpointManager(tmp_path, fingerprint=config_fingerprint(
+        {"dim": 128}))
+    assert other.latest_valid() is None
+    assert CheckpointManager(tmp_path).latest_valid().step == 3
+
+
+def test_retention_keep_last_and_keep_every(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last=2, keep_every=4)
+    for step in range(1, 9):
+        mgr.save(step, payload(step))
+    kept = sorted(int(p.name.split("-")[1]) for p in tmp_path.iterdir())
+    # last 2 (7, 8) + keep_every multiples (4, 8)
+    assert kept == [4, 7, 8]
+    # keep_last=0 keeps everything
+    mgr2 = CheckpointManager(tmp_path / "all", keep_last=0)
+    for step in (1, 2, 3):
+        mgr2.save(step, payload(step))
+    assert len(list((tmp_path / "all").iterdir())) == 3
+
+
+def test_save_retries_transient_failures(tmp_path, capsys):
+    """fail_after=0: the first write attempt raises; the backoff retry
+    lands and the checkpoint verifies."""
+    faults.install("ckpt_write:fail_after=0")
+    mgr = CheckpointManager(tmp_path, retries=2, backoff=0.01)
+    mgr.save(1, payload(1))
+    assert mgr.latest_valid().step == 1
+    assert "retrying" in capsys.readouterr().err
+
+
+def test_save_raises_after_retry_budget(tmp_path):
+    faults.install("ckpt_write:every=1")  # every attempt fails
+    mgr = CheckpointManager(tmp_path, retries=2, backoff=0.01)
+    with pytest.raises(OSError):
+        mgr.save(1, payload(1))
+    assert mgr.latest_valid() is None  # nothing half-published
+
+
+def test_truncate_injection_produces_detectable_tear(tmp_path):
+    """The truncate faultpoint models post-publish corruption: manifest
+    present, CRC wrong — exactly what latest_valid must catch."""
+    faults.install("ckpt_write:truncate=1")
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, payload(1))
+    assert (tmp_path / "ckpt-00000001" / MANIFEST).exists()
+    assert mgr.latest_valid() is None  # caught by CRC, not by absence
+    faults.reset()
+    mgr.save(2, payload(2))
+    assert mgr.latest_valid().step == 2
+
+
+def test_save_same_step_is_idempotent(tmp_path):
+    """A step with a VALID manifest is never rewritten (the interrupt path
+    can land on a step the cadence just saved) — but an invalid dir at the
+    same step IS retried."""
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, payload(1))
+    before = (tmp_path / "ckpt-00000001" / MANIFEST).stat().st_mtime_ns
+    mgr.save(1, {"weights": {"w": np.zeros((1,), np.float32)}})
+    assert (tmp_path / "ckpt-00000001" / MANIFEST).stat().st_mtime_ns \
+        == before
+    back = load_checkpoint(mgr.latest_valid().payload)
+    np.testing.assert_array_equal(back["weights"]["w"],
+                                  payload(1)["weights"]["w"])
+
+
+def test_sharded_orbax_payload_roundtrip(tmp_path):
+    """sharded=True: the payload is an Orbax dir; the manifest covers every
+    shard file and load_checkpoint accepts the payload dir directly."""
+    import jax.numpy as jnp
+
+    mgr = CheckpointManager(tmp_path, sharded=True)
+    obj = {"weights": {"w": jnp.arange(16.0).reshape(4, 4)}, "epoch": 2}
+    data = mgr.save(5, obj)
+    assert data.is_dir()
+    manifest = json.loads((data.parent / MANIFEST).read_text())
+    assert manifest["payload"] == "data.orbax"
+    assert len(manifest["files"]) >= 1
+    info = mgr.latest_valid()
+    assert info.step == 5
+    back = load_checkpoint(info.payload)
+    np.testing.assert_array_equal(np.asarray(back["weights"]["w"]),
+                                  np.asarray(obj["weights"]["w"]))
+    assert int(back["epoch"]) == 2

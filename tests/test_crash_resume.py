@@ -1,0 +1,277 @@
+"""Crash/resume equivalence under injected faults — the chaos harness.
+
+The acceptance gate for the crash-consistency subsystem (this is also CI's
+``crash-resume`` job): train K steps on CPU with ``GRAFT_FAULTS`` injecting
+a SIGTERM (preemption) AND a torn final checkpoint write, auto-resume with
+``--resume auto``, and require that
+
+* the torn newest checkpoint is SKIPPED and resume falls back to the
+  previous good one (manifest CRC catches the tear);
+* the resumed run completes, and its post-resume loss log lines and final
+  weights/optimizer/scheduler state are **bitwise identical** to an
+  uninterrupted baseline — exact mid-epoch resume (data order, RNG stream,
+  plateau-scheduler epoch mean) with nothing replayed and nothing lost;
+* a corrupt sample on disk is quarantined and the run still finishes.
+
+Runs the real CLI mains in-process (same pattern as test_cli.py) on tiny
+geometry; determinism holds because the loader, augmentations, and RNG are
+all seed-derived and XLA:CPU executables are process-cached.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+VOCAB_WORDS = ["red", "green", "blue", "yellow", "circle", "square", "bird",
+               "a", "the", "of"]
+HPARAMS = dict(BATCH_SIZE=4, MODEL_DIM=32, TEXT_SEQ_LEN=8, DEPTH=2,
+               HEADS=2, DIM_HEAD=16, ATTN_TYPES=["full", "axial_row"])
+# 12 pairs / batch 4 = 3 steps per epoch; 4 epochs = steps 1..12.
+# Managed saves (--ckpt_every 4, it==0 of each epoch) land at steps
+# 1, 4, 7, 10; SIGTERM at step 7 with the 3rd ckpt write torn means the
+# step-7 checkpoint is the torn one and resume must fall back to step 4.
+EPOCHS = 4
+CKPT_EVERY = 4
+FAULTS = "sigterm:at_step=7,ckpt_write:truncate=3"
+
+
+@pytest.fixture(scope="module")
+def tiny_tokenizer_json(tmp_path_factory):
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {"[UNK]": 0}
+    for w in VOCAB_WORDS:
+        vocab[w] = len(vocab)
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    path = tmp_path_factory.mktemp("tok") / "tiny_tokenizer.json"
+    tok.save(str(path))
+    return path
+
+
+@pytest.fixture(scope="module")
+def tiny_dataset(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    folder = tmp_path_factory.mktemp("data")
+    from PIL import Image
+
+    for i in range(12):
+        img = (rng.uniform(size=(24, 24, 3)) * 255).astype(np.uint8)
+        Image.fromarray(img).save(folder / f"sample_{i}.png")
+        words = rng.choice(VOCAB_WORDS, size=3, replace=True)
+        (folder / f"sample_{i}.txt").write_text(" ".join(words) + "\n")
+    return folder
+
+
+@pytest.fixture(scope="module")
+def tiny_vae_ckpt(tmp_path_factory):
+    """A random (untrained) frozen VAE — the trainer only needs its
+    geometry and weights, so no stage-1 training is required here."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import DiscreteVAE, VAEConfig
+    from dalle_pytorch_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = VAEConfig(image_size=16, num_layers=2, num_tokens=32,
+                    codebook_dim=16, hidden_dim=16, num_resnet_blocks=0)
+    vae = DiscreteVAE(cfg)
+    k = jax.random.PRNGKey(7)
+    params = vae.init({"params": k, "gumbel": k},
+                      jnp.zeros((1, 16, 16, 3)))["params"]
+    path = tmp_path_factory.mktemp("vae") / "vae.pt"
+    save_checkpoint(path, {"hparams": cfg.to_dict(),
+                           "weights": jax.device_get(params)})
+    return path
+
+
+def run_train(workdir, data, vae, tok, extra_args, faults_spec=None,
+              epochs=EPOCHS):
+    env_before = os.environ.get("GRAFT_FAULTS")
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps(HPARAMS)
+    if faults_spec is None:
+        os.environ.pop("GRAFT_FAULTS", None)
+    else:
+        os.environ["GRAFT_FAULTS"] = faults_spec
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        import train_dalle
+
+        train_dalle.main(["--image_text_folder", str(data),
+                          "--bpe_path", str(tok),
+                          "--truncate_captions",
+                          "--learning_rate", "1e-3",
+                          "--epochs", str(epochs),
+                          "--ckpt_every", str(CKPT_EVERY),
+                          "--keep_checkpoints", "8"]
+                         + (["--vae_path", str(vae)] if vae else [])
+                         + extra_args)
+    finally:
+        os.chdir(cwd)
+        del os.environ["DALLE_TPU_HPARAMS"]
+        if env_before is None:
+            os.environ.pop("GRAFT_FAULTS", None)
+        else:
+            os.environ["GRAFT_FAULTS"] = env_before
+    from dalle_pytorch_tpu.utils import faults as faults_mod
+
+    faults_mod.reset()  # never leak an armed registry into the next run
+
+
+def log_lines(workdir):
+    """{(epoch, iter): raw line} from the newest step log."""
+    logs = sorted(workdir.glob("dalle_tpu_train_transformer-*.txt"),
+                  key=lambda p: p.stat().st_mtime)
+    out = {}
+    for line in logs[-1].read_text().strip().split("\n"):
+        parts = line.split(" ")
+        out[(int(parts[0]), int(parts[1]))] = line
+    return out
+
+
+@pytest.fixture(scope="module")
+def baseline(tiny_dataset, tiny_vae_ckpt, tiny_tokenizer_json,
+             tmp_path_factory):
+    wd = tmp_path_factory.mktemp("baseline")
+    run_train(wd, tiny_dataset, tiny_vae_ckpt, tiny_tokenizer_json, [])
+    return wd
+
+
+def test_crash_resume_bitwise_equivalence(baseline, tiny_dataset,
+                                          tiny_vae_ckpt, tiny_tokenizer_json,
+                                          tmp_path_factory, capsys):
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+    from dalle_pytorch_tpu.utils.ckpt_manager import latest_valid, verify
+
+    wd = tmp_path_factory.mktemp("chaos")
+
+    # --- phase 1: the run is preempted at step 7 and its final managed
+    # checkpoint write is torn -------------------------------------------
+    run_train(wd, tiny_dataset, tiny_vae_ckpt, tiny_tokenizer_json, [],
+              faults_spec=FAULTS)
+    assert not (wd / "dalle-final.pt").exists()  # it really died early
+    ckpts = wd / "checkpoints"
+    # the torn step-7 checkpoint published a manifest but fails its CRC...
+    assert (ckpts / "ckpt-00000007" / "manifest.json").exists()
+    assert verify(ckpts / "ckpt-00000007") is None
+    # ...so the newest VALID checkpoint is the previous good one (step 4)
+    info = latest_valid(ckpts)
+    assert info is not None and info.step == 4
+
+    # --- phase 2: auto-resume skips the torn checkpoint and completes ----
+    run_train(wd, tiny_dataset, None, tiny_tokenizer_json,
+              ["--resume", "auto"])
+    out = capsys.readouterr().out
+    assert "auto-resume: step 4" in out
+    assert (wd / "dalle-final.pt").exists()
+
+    # --- equivalence: bitwise-identical to the uninterrupted baseline ----
+    base = load_checkpoint(baseline / "dalle-final.pt")
+    resumed = load_checkpoint(wd / "dalle-final.pt")
+    for key in ("weights", "opt_state"):
+        b_leaves = [np.asarray(v) for v in _leaves(base[key])]
+        r_leaves = [np.asarray(v) for v in _leaves(resumed[key])]
+        assert len(b_leaves) == len(r_leaves)
+        for b, r in zip(b_leaves, r_leaves):
+            np.testing.assert_array_equal(b, r)  # bitwise, no tolerance
+    assert dict(base["scheduler"]) == dict(resumed["scheduler"])
+    assert list(base["rng"]) == list(resumed["rng"])
+    assert int(base["global_step"]) == int(resumed["global_step"]) == 12
+    assert dict(base["loader"]) == dict(resumed["loader"])
+
+    # the post-resume loss/sample-order trajectory matches the baseline's
+    # log LINE FOR LINE (same epoch/iter keys, same printed floats)
+    base_log = log_lines(baseline)
+    resumed_log = log_lines(wd)
+    assert resumed_log, "resumed run logged nothing"
+    for key, line in resumed_log.items():
+        assert base_log.get(key) == line, (key, line, base_log.get(key))
+    # and it really was a partial replay: the resumed log starts after the
+    # step-4 checkpoint, not at (0, 0)
+    assert (0, 0) not in resumed_log
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _leaves(tree[k])
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _leaves(v)
+    elif hasattr(tree, "shape"):
+        yield tree
+
+
+def test_resume_auto_on_fresh_dir_starts_fresh(tiny_dataset, tiny_vae_ckpt,
+                                               tiny_tokenizer_json,
+                                               tmp_path_factory, capsys):
+    """--resume auto with no checkpoints is a fresh start, not a crash."""
+    wd = tmp_path_factory.mktemp("fresh")
+    run_train(wd, tiny_dataset, tiny_vae_ckpt, tiny_tokenizer_json,
+              ["--resume", "auto"], epochs=1)
+    assert "no valid checkpoint" in capsys.readouterr().out
+    assert (wd / "dalle-final.pt").exists()
+
+
+def test_vae_sigterm_and_auto_resume(tiny_dataset, tmp_path_factory, capsys):
+    """train_vae has the same wiring: preempted mid-run via GRAFT_FAULTS,
+    then --resume auto continues from the newest managed checkpoint to the
+    configured epoch count."""
+    import train_vae
+    from dalle_pytorch_tpu.utils import faults as faults_mod
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+    from dalle_pytorch_tpu.utils.ckpt_manager import latest_valid
+
+    wd = tmp_path_factory.mktemp("vae_chaos")
+    hparams = dict(EPOCHS=2, BATCH_SIZE=4, NUM_TOKENS=32, NUM_LAYERS=2,
+                   NUM_RESNET_BLOCKS=0, EMB_DIM=16, HID_DIM=16)
+    args = ["--image_folder", str(tiny_dataset), "--image_size", "16",
+            "--ckpt_every", "2"]
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps(hparams)
+    cwd = os.getcwd()
+    os.chdir(wd)
+    try:
+        os.environ["GRAFT_FAULTS"] = "sigterm:at_step=4"
+        train_vae.main(list(args))
+        faults_mod.reset()
+        os.environ.pop("GRAFT_FAULTS")
+        assert not (wd / "vae-final.pt").exists()
+        info = latest_valid(wd / "checkpoints")
+        assert info is not None and info.step == 4
+
+        train_vae.main(list(args) + ["--resume", "auto"])
+        faults_mod.reset()
+    finally:
+        os.chdir(cwd)
+        del os.environ["DALLE_TPU_HPARAMS"]
+        os.environ.pop("GRAFT_FAULTS", None)
+    assert "auto-resume: step 4" in capsys.readouterr().out
+    assert int(load_checkpoint(wd / "vae-final.pt")["epoch"]) == 2
+
+
+def test_corrupt_sample_does_not_kill_training(baseline, tiny_dataset,
+                                               tiny_vae_ckpt,
+                                               tiny_tokenizer_json,
+                                               tmp_path_factory, capsys):
+    """One truncated image on disk: the sample is quarantined (logged) and
+    the run completes — graceful degradation at trainer level."""
+    data = tmp_path_factory.mktemp("rot")
+    for p in tiny_dataset.iterdir():
+        shutil.copy(p, data / p.name)
+    bad = data / "sample_5.png"
+    bad.write_bytes(bad.read_bytes()[:30])
+
+    wd = tmp_path_factory.mktemp("rot_run")
+    run_train(wd, data, tiny_vae_ckpt, tiny_tokenizer_json, [], epochs=1)
+    assert (wd / "dalle-final.pt").exists()
+    assert "quarantining sample sample_5" in capsys.readouterr().out

@@ -176,6 +176,100 @@ def test_heartbeat_done_marker(tmp_path):
     assert "done" not in Heartbeat.read(hb2.path)
 
 
+def test_graceful_shutdown_second_signal_escalates():
+    """A second delivery of the same signal restores the PREVIOUS handler
+    and re-raises through it — an impatient double ctrl-C/kill must
+    terminate immediately instead of waiting on the checkpoint."""
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        with GracefulShutdown() as stopper:
+            signal.raise_signal(signal.SIGTERM)
+            assert stopper.requested and hits == []  # first: flag only
+            signal.raise_signal(signal.SIGTERM)
+            # second: escalated straight to the pre-existing handler
+            assert hits == [signal.SIGTERM]
+            assert signal.getsignal(signal.SIGTERM) is not stopper._handler
+        # __exit__ after an escalation is a clean no-op (already restored)
+        assert hits == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_heartbeat_sweeps_stale_temp_files(tmp_path):
+    """A process killed inside _write leaks a .hb-* temp; a new Heartbeat
+    in the same dir sweeps temps older than a few beat intervals and keeps
+    fresh ones (a peer process may be mid-write right now)."""
+    import os
+
+    stale = tmp_path / ".hb-stale123"
+    stale.write_text("{")
+    old = time.time() - 3600
+    os.utime(stale, (old, old))
+    fresh = tmp_path / ".hb-fresh456"
+    fresh.write_text("{")
+
+    hb = Heartbeat(tmp_path, beat_interval=15.0)
+    try:
+        assert not stale.exists()
+        assert fresh.exists()
+    finally:
+        hb.close()
+
+
+def test_monitor_restart_cmd_and_budget(tmp_path, capsys):
+    """tools/monitor.py --restart-cmd: a stalled run triggers the restart
+    command (which resolves {ckpt} to the newest manifest-valid managed
+    checkpoint); the budget bounds the loop (exit 3); with no valid
+    checkpoint there is nothing to restart from."""
+    import sys as _sys
+    from pathlib import Path
+
+    import numpy as np
+
+    _sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import monitor
+
+    from dalle_pytorch_tpu.utils.ckpt_manager import CheckpointManager
+
+    # a stalled heartbeat (old timestamp)
+    hb = Heartbeat(tmp_path)
+    hb.beat(5)
+    hb.close()
+    payload = json.loads(hb.path.read_text())
+    payload["time"] -= 1000
+    hb.path.write_text(json.dumps(payload))
+
+    ckpts = tmp_path / "ckpts"
+    marker = tmp_path / "restarts.log"
+
+    # no valid checkpoint yet -> nothing to restart from, exit 3, no cmd run
+    assert monitor.main([str(tmp_path), "--timeout", "300",
+                         "--restart-cmd", f"echo r >> {marker}",
+                         "--ckpt-dir", str(ckpts)]) == 3
+    assert not marker.exists()
+
+    CheckpointManager(ckpts).save(
+        9, {"weights": {"w": np.zeros((2,), np.float32)}})
+
+    # single-shot: one restart fires, {ckpt} resolves to the payload path
+    code = monitor.main([str(tmp_path), "--timeout", "300",
+                         "--restart-cmd", f"echo {{ckpt}} >> {marker}",
+                         "--ckpt-dir", str(ckpts)])
+    assert code == 1  # the scan itself still reports the stall
+    assert "ckpt-00000009" in marker.read_text()
+
+    # watch mode: the budget bounds the loop and exits 3
+    marker.unlink()
+    code = monitor.main([str(tmp_path), "--timeout", "300",
+                         "--watch", "0.01", "--max-restarts", "2",
+                         "--restart-cmd", f"echo r >> {marker}",
+                         "--ckpt-dir", str(ckpts)])
+    assert code == 3
+    assert marker.read_text().count("r") == 2
+    capsys.readouterr()  # drain scan output
+
+
 def test_watchdog_quiet_before_first_step(tmp_path, capfd):
     """The construction->first-beat stretch includes the XLA compile
     (minutes at real sizes) and must not read as a stall."""

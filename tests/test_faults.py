@@ -1,0 +1,249 @@
+"""Fault-injection registry (GRAFT_FAULTS) + data-loader degradation.
+
+The recovery paths are the least-run code in any trainer; these tests pin
+the injector grammar/semantics and the dataset's retry-then-quarantine
+behavior so the chaos harness (tests/test_crash_resume.py, CI's
+crash-resume job) stands on a deterministic foundation.
+"""
+from __future__ import annotations
+
+import signal
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.utils import faults
+from dalle_pytorch_tpu.utils.faults import FaultRegistry, InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Each test installs its own spec; never leak one into the next."""
+    yield
+    faults.reset()
+
+
+def test_spec_grammar_rejects_garbage():
+    for bad in ("ckpt_write", "ckpt_write:boom=1", "ckpt_write:every=x",
+                ":every=1", "ckpt_write:every=-2"):
+        with pytest.raises(ValueError):
+            FaultRegistry(bad)
+    # empty / whitespace specs are a no-op registry
+    assert FaultRegistry("").empty
+    assert FaultRegistry("  ").empty
+
+
+def test_fail_after_is_one_shot():
+    reg = FaultRegistry("ckpt_write:fail_after=2")
+    assert reg.fire("ckpt_write") == frozenset()
+    assert reg.fire("ckpt_write") == frozenset()
+    with pytest.raises(InjectedFault):
+        reg.fire("ckpt_write")  # hit 3 = fail_after 2 + 1
+    # one-shot: the retry after the failure succeeds
+    assert reg.fire("ckpt_write") == frozenset()
+    assert reg.hits("ckpt_write") == 4
+
+
+def test_every_is_periodic():
+    reg = FaultRegistry("sample_read:every=3")
+    hits, failures = 0, 0
+    for _ in range(9):
+        hits += 1
+        try:
+            reg.fire("sample_read")
+        except InjectedFault:
+            failures += 1
+    assert failures == 3  # hits 3, 6, 9
+
+
+def test_truncate_returned_once_to_caller():
+    reg = FaultRegistry("ckpt_write:truncate=2")
+    assert reg.fire("ckpt_write") == frozenset()
+    assert reg.fire("ckpt_write") == frozenset({"truncate"})
+    assert reg.fire("ckpt_write") == frozenset()
+
+
+def test_sites_are_independent_and_combinable():
+    reg = FaultRegistry("a:every=1,b:truncate=1")
+    assert reg.fire("b") == frozenset({"truncate"})
+    with pytest.raises(InjectedFault):
+        reg.fire("a")
+    assert reg.fire("unknown_site") == frozenset()
+
+
+def test_install_from_env_reparses(monkeypatch):
+    monkeypatch.setenv("GRAFT_FAULTS", "x:every=1")
+    faults.install_from_env()
+    with pytest.raises(InjectedFault):
+        faults.fire("x")
+    # the trainer reruns in-process: a changed env must take effect
+    monkeypatch.setenv("GRAFT_FAULTS", "")
+    faults.install_from_env()
+    assert faults.fire("x") == frozenset()
+
+
+def test_maybe_kill_delivers_sigterm_at_step():
+    faults.install("sigterm:at_step=3")
+    got = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: got.append(s))
+    try:
+        faults.maybe_kill(1)
+        faults.maybe_kill(2)
+        assert got == []
+        faults.maybe_kill(3)
+        assert got == [signal.SIGTERM]
+        faults.maybe_kill(3)  # one-shot
+        assert got == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# --- data-loader graceful degradation ------------------------------------
+
+
+class _WordTok:
+    def tokenize(self, text, context_length, truncate_text=False):
+        ids = [sum(map(ord, w)) % 50 + 1 for w in text.split()]
+        out = np.zeros((1, context_length), np.int64)
+        out[0, : len(ids[:context_length])] = ids[:context_length]
+        return out
+
+
+def _make_pairs(folder, n=8, size=16):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        img = (rng.uniform(size=(size, size, 3)) * 255).astype(np.uint8)
+        Image.fromarray(img).save(folder / f"s{i}.png")
+        (folder / f"s{i}.txt").write_text("a b\n")
+
+
+def _dataset(folder):
+    from dalle_pytorch_tpu.data.dataset import TextImageDataset
+
+    return TextImageDataset(folder, _WordTok(), text_len=4, image_size=8,
+                            resize_ratio=0.5)
+
+
+def test_corrupt_sample_quarantined_run_survives(tmp_path, capsys):
+    """A truncated image is retried, quarantined (logged), and the epoch
+    completes with a neighboring sample substituted — one bad JPEG must
+    not kill a pod-scale run."""
+    _make_pairs(tmp_path)
+    # corrupt one image on disk (a torn download / bit-rot victim)
+    bad = tmp_path / "s3.png"
+    bad.write_bytes(bad.read_bytes()[:20])
+
+    ds = _dataset(tmp_path)
+    out = [ds.item(i, epoch=0) for i in range(len(ds))]
+    assert len(out) == len(ds)  # every index yielded something
+    assert ds._quarantined == {"s3"}
+    assert "quarantining sample s3" in capsys.readouterr().out
+    # quarantined keys are skipped without a retry storm in later epochs
+    ds.item(3, epoch=1)
+    assert ds._quarantined == {"s3"}
+
+
+def test_injected_read_faults_quarantine_and_survive(tmp_path):
+    """GRAFT_FAULTS sample_read:every=K: the first failure of a sample is
+    retried (transient semantics — the retry's fire() usually passes);
+    persistent failures quarantine.  The run survives either way."""
+    _make_pairs(tmp_path)
+    faults.install("sample_read:every=5")
+    ds = _dataset(tmp_path)
+    for epoch in range(2):
+        for i in range(len(ds)):
+            tokens, arr = ds.item(i, epoch=epoch)
+            assert arr.shape == (8, 8, 3)
+    # every=5 with a same-key retry means most failures healed on retry
+    assert len(ds._quarantined) <= 2
+
+
+def test_quarantine_cap_fails_loudly(tmp_path):
+    """A rotten dataset (every read fails) must raise, not silently train
+    on nothing: the quarantine is capped."""
+    _make_pairs(tmp_path, n=30)
+    faults.install("sample_read:every=1")  # nothing ever reads
+    ds = _dataset(tmp_path)
+    ds.max_quarantine = 3
+    with pytest.raises(RuntimeError, match="quarantined"):
+        for i in range(len(ds)):
+            ds.item(i, epoch=0)
+
+
+# --- DataLoader exact-resume state ---------------------------------------
+
+
+class RangeDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.asarray([i], np.float32)
+
+
+def test_dataloader_state_roundtrip_mid_epoch():
+    """Consume k batches, snapshot, restore into a fresh loader: the
+    resumed stream is exactly the remainder of the epoch plus the next
+    epochs — same permutation, no replay, no loss."""
+    from dalle_pytorch_tpu.data.dataset import DataLoader
+
+    def flat(batches):
+        return [int(v) for b in batches for v in np.asarray(b).reshape(-1)]
+
+    a = DataLoader(RangeDataset(32), batch_size=4, shuffle=True, seed=9,
+                   num_workers=0)
+    it = iter(a)
+    consumed = [next(it) for _ in range(3)]
+    state = a.state_dict()
+    assert state == {"seed": 9, "epoch": 0, "cursor": 3}
+    rest = list(it) + list(a)  # remainder of epoch 0, then epoch 1
+
+    b = DataLoader(RangeDataset(32), batch_size=4, shuffle=True, seed=0,
+                   num_workers=0)
+    b.load_state_dict(state)
+    resumed = list(b) + list(b)
+    assert flat(resumed) == flat(rest)
+    assert flat(consumed) + flat(resumed[:5]) == flat(
+        DataLoader(RangeDataset(32), batch_size=4, shuffle=True, seed=9,
+                   num_workers=0))
+
+
+def test_dataloader_state_at_epoch_boundary_yields_empty_epoch():
+    """cursor == len(dl): the next __iter__ yields nothing (the trainer
+    replays its epoch-end bookkeeping exactly once), and the epoch after
+    that is the NEXT permutation."""
+    from dalle_pytorch_tpu.data.dataset import DataLoader
+
+    a = DataLoader(RangeDataset(16), batch_size=4, shuffle=True, seed=5,
+                   num_workers=0)
+    list(a)  # epoch 0 fully consumed
+    state = a.state_dict()
+    assert state["epoch"] == 0 and state["cursor"] == 4
+
+    b = DataLoader(RangeDataset(16), batch_size=4, shuffle=True, seed=5,
+                   num_workers=0)
+    b.load_state_dict(state)
+    assert list(b) == []  # boundary: empty replay of epoch 0
+    nxt = [int(v) for batch in b for v in np.asarray(batch).reshape(-1)]
+    second_epoch = list(a)
+    assert nxt == [int(v) for batch in second_epoch
+                   for v in np.asarray(batch).reshape(-1)]
+
+
+def test_dataloader_state_with_prefetch_counts_delivered_batches():
+    """The cursor counts batches the consumer RECEIVED, not batches the
+    prefetcher has in flight — a checkpoint mid-epoch must skip exactly
+    the consumed prefix."""
+    from dalle_pytorch_tpu.data.dataset import DataLoader
+
+    a = DataLoader(RangeDataset(40), batch_size=4, shuffle=True, seed=2,
+                   num_workers=4, prefetch=3)
+    it = iter(a)
+    for _ in range(2):
+        next(it)
+    assert a.state_dict()["cursor"] == 2

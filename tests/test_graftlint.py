@@ -259,6 +259,54 @@ def test_exc001_pragma_line_above():
     assert lint(src, select=("EXC001",)) == []
 
 
+# --- CKPT001 -------------------------------------------------------------
+
+
+def test_ckpt001_raw_durable_writes_flagged():
+    src = """
+    from pathlib import Path
+    ckpt_path = "run/ckpt-00000001/data.msgpack"
+    with open(ckpt_path, "wb") as f:
+        f.write(b"x")
+    Path("hb/heartbeat-p0.json").write_text("{}")
+    manifest = Path("run") / "manifest.json"
+    with manifest.open("w") as f:
+        f.write("{}")
+    """
+    found = lint(src, select=("CKPT001",), path="train_x.py")
+    assert rules_of(found) == ["CKPT001"] * 3
+
+
+def test_ckpt001_reads_and_unrelated_writes_clean():
+    src = """
+    with open(ckpt_path, "rb") as f:
+        data = f.read()
+    with open("results.txt", "w") as f:
+        f.write("ok")
+    log_path.write_text("line")
+    mode = compute_mode()
+    open(ckpt_path, mode)  # non-literal mode: not provably a write
+    """
+    assert lint(src, select=("CKPT001",), path="train_x.py") == []
+
+
+def test_ckpt001_utils_helpers_exempt():
+    """The atomic-rename helpers themselves live under utils/ and must be
+    allowed to touch checkpoint bytes; the same write anywhere else is
+    flagged."""
+    src = 'open(ckpt_tmp, "wb").write(b"x")\n'
+    assert lint_source(src, path="dalle_pytorch_tpu/utils/checkpoint.py",
+                       select=("CKPT001",)) == []
+    assert rules_of(lint_source(src, path="tools/convert.py",
+                                select=("CKPT001",))) == ["CKPT001"]
+
+
+def test_ckpt001_pragma_with_reason_suppresses():
+    src = ("open(ckpt_debug_dump, 'w').write('x')  "
+           "# graftlint: disable=CKPT001 (debug dump, not durable run state)\n")
+    assert lint_source(src, path="train_x.py", select=("CKPT001",)) == []
+
+
 # --- engine machinery ----------------------------------------------------
 
 
@@ -373,7 +421,7 @@ def test_every_rule_has_fixture_coverage():
     """Meta: the rule registry and this file stay in sync — adding a rule
     without positive-fixture coverage fails here."""
     covered = {"ENV001", "SEED001", "BACKEND001", "DOT001", "TRACE001",
-               "EXC001"}
+               "EXC001", "CKPT001"}
     assert covered == set(RULES)
 
 

@@ -126,6 +126,56 @@ if ! env JAX_PLATFORMS=cpu timeout 600 python tools/contract_check.py \
 fi
 echo "$(date +%T) contract check PASS"
 
+# -- optional training auto-restart supervisor -----------------------------
+# Arm with BABYSIT_TRAIN_CMD="python train_dalle.py --image_text_folder ..."
+# (do NOT include --resume/--heartbeat_dir — the supervisor adds them).
+# The run is launched with `--resume auto`, so every (re)launch resumes
+# from the newest manifest-valid managed checkpoint, falling back past a
+# torn final write; stalled-or-dead per tools/monitor.py heartbeat scan ->
+# kill + relaunch, bounded by BABYSIT_MAX_RESTARTS.  Inactive when the env
+# var is unset, so the measurement queue below is unaffected.
+if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
+  BABYSIT_HB_DIR=${BABYSIT_HB_DIR:-${CHIP_TMP}/train_hb}
+  BABYSIT_MAX_RESTARTS=${BABYSIT_MAX_RESTARTS:-3}
+  BABYSIT_STALL_TIMEOUT=${BABYSIT_STALL_TIMEOUT:-600}
+  BABYSIT_POLL=${BABYSIT_POLL:-60}
+  (
+    restarts=0
+    while :; do
+      echo "$(date +%T) train supervisor: launch (restarts so far: $restarts/${BABYSIT_MAX_RESTARTS})"
+      ${BABYSIT_TRAIN_CMD} --resume auto --heartbeat_dir "${BABYSIT_HB_DIR}" \
+        >> "${CHIP_TMP}/train_run.log" 2>&1 &
+      train_pid=$!
+      while kill -0 "$train_pid" 2>/dev/null; do
+        sleep "$BABYSIT_POLL"
+        python tools/monitor.py "${BABYSIT_HB_DIR}" \
+          --timeout "${BABYSIT_STALL_TIMEOUT}" >/dev/null 2>&1
+        if [ $? -eq 1 ]; then  # stalled (a done/healthy run exits 0)
+          echo "$(date +%T) train supervisor: stalled heartbeats — killing $train_pid"
+          kill "$train_pid" 2>/dev/null; sleep 5
+          kill -9 "$train_pid" 2>/dev/null
+          break
+        fi
+      done
+      wait "$train_pid"; rc=$?
+      # a done-marked heartbeat means the run FINISHED — never relaunch it
+      if grep -q '"done": true' "${BABYSIT_HB_DIR}"/heartbeat-p*.json 2>/dev/null; then
+        echo "$(date +%T) train supervisor: run completed"; break
+      fi
+      if [ "$rc" -eq 0 ]; then
+        echo "$(date +%T) train supervisor: run exited cleanly"; break
+      fi
+      restarts=$((restarts+1))
+      if [ "$restarts" -gt "$BABYSIT_MAX_RESTARTS" ]; then
+        echo "$(date +%T) train supervisor: restart budget exhausted"; break
+      fi
+      echo "$(date +%T) train supervisor: rc=$rc — restarting from the last good checkpoint"
+    done
+  ) &
+  TRAIN_SUP_PID=$!
+  trap 'harvest_once; kill "$HARVEST_PID" "$TRAIN_SUP_PID" 2>/dev/null' EXIT
+fi
+
 # -- the queue, highest evidence value first -------------------------------
 # bf16 KV cache at eval dtype (f32 activations) vs the f32-cache control:
 # the decode loop is measured HBM-bound on cache reads (gen_ab 2.16x), so

@@ -8,15 +8,27 @@ supervisor loop — and exits non-zero when any host has gone quiet, so a
 wrapper script can alert or restart the run.  (SURVEY.md §5.3: the reference
 has no failure detection at all.)
 
+With ``--restart-cmd`` the monitor is a full babysitter: a stalled/dead
+scan runs the command (typically the trainer relaunched with ``--resume
+auto``, which resumes from the newest manifest-valid managed checkpoint,
+falling back past torn ones), bounded by ``--max-restarts``.  When
+``--ckpt-dir`` is given the restart only fires if that directory holds a
+manifest-valid checkpoint, and ``{ckpt}`` in the command expands to its
+payload path.
+
 Usage:
     python tools/monitor.py HEARTBEAT_DIR [--timeout 300] [--expect N] [--watch S]
+    python tools/monitor.py hb --watch 60 --ckpt-dir checkpoints \
+        --restart-cmd 'nohup python train_dalle.py --resume auto ... &'
 
-Exit codes: 0 all hosts healthy, 1 stalled/missing hosts, 2 no heartbeats.
+Exit codes: 0 all hosts healthy, 1 stalled/missing hosts, 2 no heartbeats,
+3 restart budget exhausted (or nothing valid to restart from).
 """
 from __future__ import annotations
 
 import argparse
 import re
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -87,12 +99,54 @@ def main(argv=None) -> int:
                         help="re-scan every S seconds instead of exiting; "
                              "on ctrl-C/SIGINT exits with the last scan's "
                              "code")
+    parser.add_argument("--restart-cmd", type=str, default=None,
+                        help="shell command to run when a scan reports "
+                             "stalled/dead hosts (exit 1) — typically the "
+                             "trainer relaunched with --resume auto; "
+                             "'{ckpt}' expands to the newest valid managed "
+                             "checkpoint's payload path when --ckpt-dir is "
+                             "given")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        help="restart budget: stop restarting (exit 3) "
+                             "after this many attempts")
+    parser.add_argument("--ckpt-dir", type=Path, default=None,
+                        help="managed checkpoint run dir; restarts only "
+                             "fire when it holds a manifest-valid "
+                             "checkpoint (latest_valid fallback semantics)")
     args = parser.parse_args(argv)
 
+    def try_restart(restarts: int) -> int | None:
+        """Run --restart-cmd once; returns an exit code to stop with, or
+        None to keep watching."""
+        if restarts >= args.max_restarts:
+            print(f"restart budget exhausted ({args.max_restarts}); "
+                  "giving up", file=sys.stderr)
+            return 3
+        cmd = args.restart_cmd
+        if args.ckpt_dir is not None:
+            from dalle_pytorch_tpu.utils.ckpt_manager import latest_valid
+
+            info = latest_valid(args.ckpt_dir)
+            if info is None:
+                print(f"no manifest-valid checkpoint under {args.ckpt_dir}; "
+                      "nothing to restart from", file=sys.stderr)
+                return 3
+            cmd = cmd.replace("{ckpt}", str(info.payload))
+        print(f"restart {restarts + 1}/{args.max_restarts}: {cmd}",
+              file=sys.stderr)
+        subprocess.run(cmd, shell=True)
+        return None
+
     code = 2
+    restarts = 0
     try:
         while True:
             code = scan(args.heartbeat_dir, args.timeout, args.expect)
+            if args.restart_cmd and code == 1:
+                stop = try_restart(restarts)
+                if stop is not None:
+                    return stop
+                restarts += 1
             if not args.watch:
                 return code
             time.sleep(args.watch)

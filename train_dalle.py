@@ -24,6 +24,7 @@ carry optimizer + scheduler state (fixing the gap noted in SURVEY.md §5.3).
 from __future__ import annotations
 
 import argparse
+import sys
 import time
 from pathlib import Path
 
@@ -38,7 +39,10 @@ from dalle_pytorch_tpu.models.dalle import generate_codes
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
 from dalle_pytorch_tpu.training import (make_dalle_train_step, make_optimizer,
                                         set_learning_rate)
+from dalle_pytorch_tpu.utils import faults
 from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from dalle_pytorch_tpu.utils.ckpt_manager import (CheckpointManager,
+                                                  config_fingerprint)
 from dalle_pytorch_tpu.utils.failure import GracefulShutdown, Heartbeat
 from dalle_pytorch_tpu.utils.images import save_image
 from dalle_pytorch_tpu.utils.logging import TrainLogger
@@ -92,6 +96,28 @@ def parse_args(argv=None):
                              '({name}.orbax) with per-host shard IO instead '
                              'of gathering to process 0 (for multi-host '
                              'scale); load sites accept both formats')
+    parser.add_argument('--resume', type=str, default=None,
+                        help="'auto': resume from the newest manifest-valid "
+                             'checkpoint in --ckpt_dir, skipping torn or '
+                             'corrupt ones; any other value is an explicit '
+                             'checkpoint path (same as --dalle_path). '
+                             'Resumes are exact mid-epoch: data order, RNG '
+                             'stream, optimizer, and scheduler continue '
+                             'bitwise from the interrupted step')
+    parser.add_argument('--ckpt_dir', type=str, default='./checkpoints',
+                        help='managed checkpoint run dir: one '
+                             'ckpt-{step:08d}/ per save, each with an '
+                             'integrity manifest (per-file crc32) published '
+                             'by atomic rename only after the data lands')
+    parser.add_argument('--keep_checkpoints', type=int, default=3,
+                        help='retention: keep the newest N managed '
+                             'checkpoints (0 keeps all)')
+    parser.add_argument('--keep_every', type=int, default=0,
+                        help='retention: additionally keep every managed '
+                             'checkpoint whose step is a multiple of M')
+    parser.add_argument('--ckpt_every', type=int, default=100,
+                        help='managed-checkpoint cadence in steps (0 '
+                             'disables the CheckpointManager entirely)')
     parser.add_argument('--mesh_sp', type=int, default=1,
                         help='sequence-parallel ways: shard the sequence '
                              'over an sp mesh axis with exact ring/Ulysses '
@@ -130,6 +156,9 @@ def parse_args(argv=None):
     args = parser.parse_args(argv)
     if args.stall_timeout and not args.heartbeat_dir:
         parser.error('--stall_timeout requires --heartbeat_dir')
+    if args.resume and args.dalle_path:
+        parser.error('--resume and --dalle_path are mutually exclusive '
+                     '(--resume auto resolves the checkpoint itself)')
     if args.mesh_sp > 1 and args.pipeline_stages > 1:
         parser.error('--mesh_sp and --pipeline_stages are mutually exclusive')
     if (args.mesh_sp > 1 or args.pipeline_stages > 1) and (
@@ -225,6 +254,29 @@ def main(argv=None):
     distr_backend.initialize()
     distr_backend.check_batch_size(BATCH_SIZE)
 
+    # chaos rehearsal hooks (GRAFT_FAULTS) — re-parsed per run so in-process
+    # reruns (tests) see the current environment, not a cached spec
+    faults.install_from_env()
+
+    # crash-consistent managed checkpoints: one manifest-validated dir per
+    # save under --ckpt_dir, with retention + auto-resume fallback
+    manager = (CheckpointManager(args.ckpt_dir,
+                                 keep_last=args.keep_checkpoints,
+                                 keep_every=args.keep_every,
+                                 sharded=args.sharded_checkpoints)
+               if args.ckpt_every > 0 else None)
+    if args.resume == 'auto':
+        info = manager.latest_valid() if manager is not None else None
+        if info is not None:
+            args.dalle_path = str(info.payload)
+            if distr_backend.is_root_worker():
+                print(f'auto-resume: step {info.step} from {info.payload}')
+        elif distr_backend.is_root_worker():
+            print(f'auto-resume: no valid checkpoint under {args.ckpt_dir}; '
+                  'starting fresh')
+    elif args.resume:
+        args.dalle_path = args.resume
+
     # execution-plan config overrides (NOT stored in checkpoints): the model
     # function is identical to dense, only the collectives differ
     sp_plan = {}
@@ -247,6 +299,10 @@ def main(argv=None):
     resume_ckpt = None
     resume_sharded = None  # Orbax dir: arrays restore direct-to-device later
     start_epoch = 0
+    start_step = 0
+    resume_rng = None
+    resume_loader = None
+    resume_epoch_losses: list = []
     if exists(args.dalle_path):
         from dalle_pytorch_tpu.utils.checkpoint import (is_sharded_checkpoint,
                                                         load_sharded_small)
@@ -279,6 +335,13 @@ def main(argv=None):
         # of a non-default run must rebuild the exact model (ref :116-133)
         TEXT_SEQ_LEN = dalle_cfg.text_seq_len
         start_epoch = int(resume_ckpt.get('epoch', 0))
+        # exact-resume extras (all plain scalars, so both the msgpack and
+        # the two-phase sharded restore deliver them here)
+        start_step = int(resume_ckpt.get('global_step', 0))
+        resume_rng = resume_ckpt.get('rng')
+        resume_loader = resume_ckpt.get('loader')
+        resume_epoch_losses = [float(v) for v in
+                               (resume_ckpt.get('epoch_losses') or [])]
     else:
         vae, vae_geom, vae_hparams, vae_weights = build_vae(args, distr_backend)
         dalle_cfg = DALLEConfig.from_vae(
@@ -298,6 +361,10 @@ def main(argv=None):
             **sp_plan,
         )
     dalle = DALLE(dalle_cfg)
+    if manager is not None:
+        # saves record the config identity; latest_valid refuses checkpoints
+        # of a *different* model on later resumes
+        manager.fingerprint = config_fingerprint(dalle_cfg.to_dict())
     # dense twin: identical param tree, no sp collectives — used for init
     # (which runs the forward outside any shard_map) and for sampling
     import dataclasses as _dc
@@ -316,6 +383,20 @@ def main(argv=None):
         ds, BATCH_SIZE, shuffle=True, drop_last=True,
         shard_num_hosts=jax.process_count(), shard_index=jax.process_index(),
     )
+    # exact mid-epoch resume: replay the interrupted epoch's permutation and
+    # skip the batches already consumed.  A loader snapshot from an earlier
+    # epoch (final/sweep checkpoints, written after the epoch-end step) just
+    # aligns the permutation stream and starts the epoch fresh.
+    resume_cursor = 0
+    if resume_loader is not None and \
+            int(dict(resume_loader).get('epoch', -1)) == start_epoch:
+        dl.load_state_dict({k: int(v)
+                            for k, v in dict(resume_loader).items()})
+        resume_cursor = min(int(dict(resume_loader).get('cursor', 0)),
+                            len(dl))
+    else:
+        dl.epoch = start_epoch
+        resume_epoch_losses = []
 
     rng = jax.random.PRNGKey(42)
     rng, init_rng = jax.random.split(rng)
@@ -570,6 +651,12 @@ def main(argv=None):
             codes = encode_fn(images)
             return _codes_step(params, opt_state, None, text, codes, rng)
 
+    if resume_rng is not None:
+        # the checkpointed RNG stream continues bitwise: every subsequent
+        # step/generation split replays exactly as the uninterrupted run's
+        rng = jnp.asarray(np.asarray([int(v) for v in resume_rng],
+                                     dtype=np.uint32))
+
     sched = ReduceLROnPlateau(
         LEARNING_RATE, factor=LR_DECAY_FACTOR, patience=LR_DECAY_PATIENCE,
         cooldown=LR_DECAY_COOLDOWN, min_lr=LR_DECAY_MIN)
@@ -599,36 +686,42 @@ def main(argv=None):
             return pp_params_to_dense(dalle, params, part.mesh)
         return params
 
-    def save_model(path, epoch):
-        if args.sharded_checkpoints:
-            # Orbax writes each host's shards directly — no gather; every
-            # process participates collectively
-            from dalle_pytorch_tpu.utils.checkpoint import \
-                save_checkpoint_sharded
+    # the partial epoch's losses ride in checkpoints so the plateau
+    # scheduler's epoch mean is bitwise identical after a mid-epoch resume;
+    # one shared list object (cleared in place per epoch) so every save
+    # closure sees the live values
+    epoch_losses: list = list(resume_epoch_losses)
 
-            payload = {
-                'hparams': dalle_cfg.to_dict(),
-                'vae_params': vae_hparams,
-                'weights': dense_params_view(),
-                'scheduler': sched.state_dict(),
-                'epoch': epoch,
-            }
-            if not pp_mode:  # pp moments are stage-stacked: weights-only
-                payload['opt_state'] = jax.tree.leaves(opt_state)
-            if is_custom_vae and vae_params is not None:
-                payload['vae_weights'] = vae_params
-            path = f'{path}.orbax'
-            save_checkpoint_sharded(path, payload)
-            return path
-        # every process participates in the fetch (sharded params span
-        # non-addressable devices multi-host); only root writes
-        weights = host_fetch(dense_params_view())
-        opt_leaves = (None if pp_mode
-                      else host_fetch(jax.tree.leaves(opt_state)))
-        vae_weights = (host_fetch(vae_params)
+    def resume_extras():
+        """Exact-resume state riding in every checkpoint payload: the RNG
+        stream, the loader position (epoch/cursor/seed), the step counter,
+        and the in-flight epoch's losses — all plain scalars, so both
+        checkpoint formats restore them without device state."""
+        extras = {
+            'rng': [int(v) for v in np.asarray(jax.device_get(rng))],
+            'loader': dl.state_dict(),
+            'global_step': int(global_step),
+        }
+        if epoch_losses:
+            extras['epoch_losses'] = [float(v) for v in epoch_losses]
+        return extras
+
+    def build_payload(epoch, fetch):
+        """The reference's checkpoint dict (+ resume-exactness extras).
+        ``fetch=True`` gathers device arrays to host numpy for the msgpack
+        writers — a collective every process must join; ``fetch=False``
+        keeps device arrays for Orbax's shard-parallel IO."""
+        weights = dense_params_view()
+        opt_leaves = (None if pp_mode  # pp moments are stage-stacked
+                      else jax.tree.leaves(opt_state))
+        vae_weights = (vae_params
                        if is_custom_vae and vae_params is not None else None)
-        if not distr_backend.is_root_worker():
-            return path
+        if fetch:
+            weights = host_fetch(weights)
+            opt_leaves = (host_fetch(opt_leaves)
+                          if opt_leaves is not None else None)
+            vae_weights = (host_fetch(vae_weights)
+                           if vae_weights is not None else None)
         payload = {
             'hparams': dalle_cfg.to_dict(),
             'vae_params': vae_hparams,  # None for pretrained VAEs (ref :167-172)
@@ -640,8 +733,44 @@ def main(argv=None):
             payload['opt_state'] = opt_leaves
         if vae_weights is not None:
             payload['vae_weights'] = vae_weights
+        payload.update(resume_extras())
+        return payload
+
+    def save_model(path, epoch):
+        if args.sharded_checkpoints:
+            # Orbax writes each host's shards directly — no gather; every
+            # process participates collectively
+            from dalle_pytorch_tpu.utils.checkpoint import \
+                save_checkpoint_sharded
+
+            path = f'{path}.orbax'
+            save_checkpoint_sharded(path, build_payload(epoch, fetch=False))
+            return path
+        # every process participates in the fetch (sharded params span
+        # non-addressable devices multi-host); only root writes
+        payload = build_payload(epoch, fetch=True)
+        if not distr_backend.is_root_worker():
+            return path
         save_checkpoint(path, payload)
         return path
+
+    last_managed = [-1]  # step of the last managed-save attempt
+
+    def save_managed(step, epoch):
+        """Managed checkpoint: ckpt_dir/ckpt-{step:08d}/ with an integrity
+        manifest, retried with backoff on transient I/O errors.  A failed
+        save is logged, not fatal — the run survives and the next cadence
+        (or the interrupt path) writes the next one."""
+        if manager is None or step == last_managed[0]:
+            return
+        last_managed[0] = step
+        payload = build_payload(epoch, fetch=not args.sharded_checkpoints)
+        if args.sharded_checkpoints or distr_backend.is_root_worker():
+            try:
+                manager.save(step, payload)
+            except OSError as e:
+                print(f'[ckpt] managed save at step {step} failed after '
+                      f'retries: {e}', file=sys.stderr, flush=True)
 
     from dalle_pytorch_tpu.utils.profiling import StepTimer, dalle_train_flops
 
@@ -650,7 +779,7 @@ def main(argv=None):
     timer = StepTimer(flops_per_step=dalle_train_flops(
         dalle_cfg, BATCH_SIZE * jax.process_count()))
     lr = sched.lr
-    global_step = 0
+    global_step = start_step
     profiling_active = False
     # preemption-safe shutdown + stall detection (SURVEY.md §5.3 — the
     # reference has neither): SIGTERM/SIGINT checkpoint-and-stop, heartbeat
@@ -665,7 +794,11 @@ def main(argv=None):
     try:
         with stopper:
             for epoch in range(start_epoch, EPOCHS):
-                epoch_losses = []
+                # in-place: the save closures hold this list object.  The
+                # first resumed epoch keeps its restored partial losses so
+                # the epoch-end plateau step sees the full epoch.
+                epoch_losses[:] = (resume_epoch_losses
+                                   if epoch == start_epoch else [])
                 # one-step-deferred loss logging: materializing the loss each step
                 # would block the host on the device (and the device on the host's
                 # data loading + log IO).  The pmean dispatch is async; float() of
@@ -689,6 +822,13 @@ def main(argv=None):
                     logger.step(epoch, it, avg_loss, lr, extra=perf)
 
                 for i, (text, images) in enumerate(dl):
+                    # `it` is the TRUE batch index in this epoch's
+                    # permutation: a mid-epoch resume skips the consumed
+                    # batches, so `i` restarts at 0 while the cadences
+                    # (sampling, checkpoints, logs) must continue from
+                    # where the interrupted run left off — bitwise replay
+                    # depends on every rng split landing at the same `it`
+                    it = i + (resume_cursor if epoch == start_epoch else 0)
                     # profiler window: steps 10-20 of the first trained epoch (past
                     # compile + warmup), root process only (ref had no profiler at
                     # all — SURVEY.md §5.1)
@@ -710,9 +850,9 @@ def main(argv=None):
                         params, opt_state, vae_params, text_b, images_b, step_rng)
 
                     flush(pending)
-                    pending = (i, loss)  # raw device loss; averaged lazily in flush
+                    pending = (it, loss)  # raw device loss; averaged lazily in flush
 
-                    just_checkpointed = i % 100 == 0
+                    just_checkpointed = it % 100 == 0
                     if just_checkpointed:
                         # periodic sample (ref :396-412): SPMD computation, so every
                         # process runs it; only root writes the image.  The
@@ -732,15 +872,25 @@ def main(argv=None):
                                                sample_text, gen_rng, filter_thres=0.9)
                         image = host_fetch(decode_images(vae_params, codes)[0])
                         if distr_backend.is_root_worker():
-                            save_image(f'samples/dalle/epoch{epoch}_iter{i}.png', image)
+                            save_image(f'samples/dalle/epoch{epoch}_iter{it}.png', image)
                             decoded = tokenizer.decode(np.asarray(text[0]))
                             logger.log({'image_caption': decoded})
                         save_model('./dalle.pt', epoch)
                         # wandb.save parity (ref :409); no-op for .orbax dirs
                         logger.save_file('./dalle.pt')
                     global_step += 1
+                    if args.ckpt_every > 0 and it % args.ckpt_every == 0:
+                        # flush first so the checkpointed epoch_losses
+                        # include THIS step — a resumed run's epoch mean
+                        # must match the uninterrupted one bitwise
+                        flush(pending)
+                        pending = None
+                        save_managed(global_step, epoch)
                     if heartbeat is not None:
-                        heartbeat.beat(global_step, epoch=epoch, loss_iter=i)
+                        heartbeat.beat(global_step, epoch=epoch, loss_iter=it)
+                    # chaos rehearsal: GRAFT_FAULTS="sigterm:at_step=N"
+                    # delivers a real preemption notice at step N
+                    faults.maybe_kill(global_step)
                     # multi-process: the collective decision from the last
                     # flush (every process saw the same 2-vector, so every
                     # process breaks at the same step — the collective save
@@ -754,10 +904,17 @@ def main(argv=None):
                                        else './dalle.pt')
                         if not just_checkpointed:  # ./dalle.pt is already current
                             resume_path = save_model('./dalle.pt', epoch)
+                        # final managed checkpoint for --resume auto (no-op
+                        # if this step's cadence save already ran — a torn
+                        # result there models dying mid-write, and resume
+                        # must fall back, not paper over it)
+                        save_managed(global_step, epoch)
                         if distr_backend.is_root_worker():
-                            print(f'interrupted at epoch {epoch} iter {i}: resume '
+                            print(f'interrupted at epoch {epoch} iter {it}: resume '
                                   f'checkpoint written to {resume_path} '
-                                  f'(--dalle_path {resume_path} to continue)')
+                                  f'(--dalle_path {resume_path} to continue; '
+                                  f'--resume auto picks the newest valid '
+                                  f'managed checkpoint)')
                         interrupted = True
                         break
                 flush(pending)
@@ -769,7 +926,11 @@ def main(argv=None):
                 lr = sched.step(epoch_loss)
                 opt_state = set_learning_rate(opt_state, lr)
                 if epoch % 19 == 0:
-                    save_model(f'./sweep1/{logger.run_name}-{epoch}.pt', epoch)
+                    # epoch + 1: this save happens AFTER the epoch-end
+                    # plateau step, so a resume from it starts the next
+                    # epoch instead of replaying this one
+                    save_model(f'./sweep1/{logger.run_name}-{epoch}.pt',
+                               epoch + 1)
                 if distr_backend.is_root_worker():
                     dt = time.perf_counter() - t0
                     print(f'epoch {epoch} done: loss {epoch_loss:.4f} lr {lr:.2e} '

@@ -18,6 +18,7 @@ rather than an explicit NCCL allreduce.
 from __future__ import annotations
 
 import argparse
+import sys
 import time
 from pathlib import Path
 
@@ -30,7 +31,10 @@ from dalle_pytorch_tpu.cli import host_fetch, enable_compilation_cache
 from dalle_pytorch_tpu.data.dataset import DataLoader, ImageFolderDataset
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
 from dalle_pytorch_tpu.training import make_optimizer, make_vae_train_step, set_learning_rate
+from dalle_pytorch_tpu.utils import faults
 from dalle_pytorch_tpu.utils.checkpoint import save_checkpoint
+from dalle_pytorch_tpu.utils.ckpt_manager import (CheckpointManager,
+                                                  config_fingerprint)
 from dalle_pytorch_tpu.utils.failure import GracefulShutdown, Heartbeat
 from dalle_pytorch_tpu.utils.images import save_image_grid
 from dalle_pytorch_tpu.utils.logging import TrainLogger
@@ -62,10 +66,32 @@ def parse_args(argv=None):
                              '({name}.orbax) with per-host shard IO instead '
                              'of gathering to process 0; --resume_path '
                              'accepts both formats')
+    parser.add_argument('--resume', type=str, default=None,
+                        help="'auto': resume from the newest manifest-valid "
+                             'checkpoint in --ckpt_dir, skipping torn or '
+                             'corrupt ones; any other value is an explicit '
+                             'checkpoint path (same as --resume_path)')
+    parser.add_argument('--ckpt_dir', type=str, default='./checkpoints',
+                        help='managed checkpoint run dir: one '
+                             'ckpt-{step:08d}/ per save, each with an '
+                             'integrity manifest (per-file crc32) published '
+                             'by atomic rename only after the data lands')
+    parser.add_argument('--keep_checkpoints', type=int, default=3,
+                        help='retention: keep the newest N managed '
+                             'checkpoints (0 keeps all)')
+    parser.add_argument('--keep_every', type=int, default=0,
+                        help='retention: additionally keep every managed '
+                             'checkpoint whose step is a multiple of M')
+    parser.add_argument('--ckpt_every', type=int, default=100,
+                        help='managed-checkpoint cadence in steps (0 '
+                             'disables the CheckpointManager entirely)')
     parser = distributed_utils.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
     if args.stall_timeout and not args.heartbeat_dir:
         parser.error('--stall_timeout requires --heartbeat_dir')
+    if args.resume and args.resume_path:
+        parser.error('--resume and --resume_path are mutually exclusive '
+                     '(--resume auto resolves the checkpoint itself)')
     return args
 
 
@@ -124,6 +150,28 @@ def main(argv=None):
     distr_backend.initialize()
     distr_backend.check_batch_size(BATCH_SIZE)
 
+    # chaos rehearsal hooks (GRAFT_FAULTS) — re-parsed per run so
+    # in-process reruns (tests) see the current environment
+    faults.install_from_env()
+
+    # crash-consistent managed checkpoints + auto-resume fallback
+    manager = (CheckpointManager(args.ckpt_dir,
+                                 keep_last=args.keep_checkpoints,
+                                 keep_every=args.keep_every,
+                                 sharded=args.sharded_checkpoints)
+               if args.ckpt_every > 0 else None)
+    if args.resume == 'auto':
+        info = manager.latest_valid() if manager is not None else None
+        if info is not None:
+            args.resume_path = str(info.payload)
+            if distr_backend.is_root_worker():
+                print(f'auto-resume: step {info.step} from {info.payload}')
+        elif distr_backend.is_root_worker():
+            print(f'auto-resume: no valid checkpoint under {args.ckpt_dir}; '
+                  'starting fresh')
+    elif args.resume:
+        args.resume_path = args.resume
+
     # resume (our §5.3 extension — the reference's train_vae.py cannot
     # resume): checkpoint hparams win over the script constants and the CLI
     # --image_size, so this must run before the dataset is built
@@ -166,6 +214,8 @@ def main(argv=None):
             kl_div_loss_weight=KL_LOSS_WEIGHT,
         )
     vae = DiscreteVAE(cfg)
+    if manager is not None:
+        manager.fingerprint = config_fingerprint(cfg.to_dict())
 
     ds = ImageFolderDataset(args.image_folder, image_size=IMAGE_SIZE)
     dl = DataLoader(
@@ -233,11 +283,28 @@ def main(argv=None):
     sched = ExponentialDecay(LEARNING_RATE, LR_DECAY_RATE)
     temp_sched = GumbelTemperature(STARTING_TEMP, TEMP_MIN, ANNEAL_RATE)
     start_epoch = 0
+    resume_cursor = 0
     if resume_ckpt is not None:
         start_epoch = int(resume_ckpt.get('epoch', 0))
         sched.lr = float(resume_ckpt.get('lr', LEARNING_RATE))
         temp_sched.value = float(resume_ckpt.get('temperature', STARTING_TEMP))
         opt_state = set_learning_rate(opt_state, sched.lr)
+        # exact mid-epoch resume: RNG stream + loader position (same
+        # permutation, consumed batches skipped).  A loader snapshot from
+        # an earlier epoch (the final checkpoint) just aligns the
+        # permutation stream for the next epoch.
+        if resume_ckpt.get('rng') is not None:
+            rng = jnp.asarray(np.asarray(
+                [int(v) for v in resume_ckpt['rng']], dtype=np.uint32))
+        resume_loader = resume_ckpt.get('loader')
+        if resume_loader is not None and \
+                int(dict(resume_loader).get('epoch', -1)) == start_epoch:
+            dl.load_state_dict({k: int(v)
+                                for k, v in dict(resume_loader).items()})
+            resume_cursor = min(int(dict(resume_loader).get('cursor', 0)),
+                                len(dl))
+        else:
+            dl.epoch = start_epoch
 
     logger = TrainLogger(
         project='dalle_tpu_train_vae',
@@ -266,6 +333,9 @@ def main(argv=None):
             'opt_state': opt_leaves,
             'epoch': epoch, 'global_step': global_step,
             'temperature': temp, 'lr': lr,
+            # exact-resume extras (plain scalars; restore without devices)
+            'rng': [int(v) for v in np.asarray(jax.device_get(rng))],
+            'loader': dl.state_dict(),
         }
 
     def save_vae_model(path, epoch):
@@ -286,6 +356,28 @@ def main(argv=None):
             save_checkpoint(path, vae_payload(weights, opt_leaves, epoch))
         return path
 
+    last_managed = [-1]  # step of the last managed-save attempt
+
+    def save_vae_managed(step, epoch):
+        """Managed checkpoint with an integrity manifest (ckpt_dir/
+        ckpt-{step:08d}/), retried with backoff; a failed save is logged,
+        not fatal."""
+        if manager is None or step == last_managed[0]:
+            return
+        last_managed[0] = step
+        if args.sharded_checkpoints:
+            payload = vae_payload(params, jax.tree.leaves(opt_state), epoch)
+        else:
+            payload = vae_payload(host_fetch(params),
+                                  host_fetch(jax.tree.leaves(opt_state)),
+                                  epoch)
+        if args.sharded_checkpoints or distr_backend.is_root_worker():
+            try:
+                manager.save(step, payload)
+            except OSError as e:
+                print(f'[ckpt] managed save at step {step} failed after '
+                      f'retries: {e}', file=sys.stderr, flush=True)
+
     global_step = (int(resume_ckpt.get('global_step', 0))
                    if resume_ckpt is not None else 0)
     lr = sched.lr
@@ -303,13 +395,18 @@ def main(argv=None):
         with stopper:
             for epoch in range(start_epoch, EPOCHS):
                 for i, images in enumerate(dl):
+                    # `it`: true batch index in this epoch's permutation —
+                    # a mid-epoch resume skips consumed batches, so the
+                    # cadences below must continue from the interrupted
+                    # position, not restart at 0
+                    it = i + (resume_cursor if epoch == start_epoch else 0)
                     batch = part.shard_batch(images)
                     rng, step_rng = jax.random.split(rng)
                     params, opt_state, loss, recons = train_step(
                         params, opt_state, batch, step_rng,
                         jnp.asarray(temp, jnp.float32))
 
-                    if i % 100 == 0:
+                    if it % 100 == 0:
                         # periodic probes (ref :187-209): SPMD computations run
                         # on every process; only root writes files
                         k = NUM_IMAGES_SAVE
@@ -319,11 +416,11 @@ def main(argv=None):
                         host_hard = host_fetch(hard)
                         host_codes = host_fetch(codes)
                         if distr_backend.is_root_worker():
-                            save_image_grid(f'samples/vae/epoch{epoch}_iter{i}_original.png',
+                            save_image_grid(f'samples/vae/epoch{epoch}_iter{it}_original.png',
                                             np.asarray(host_imgs))
-                            save_image_grid(f'samples/vae/epoch{epoch}_iter{i}_soft.png',
+                            save_image_grid(f'samples/vae/epoch{epoch}_iter{it}_soft.png',
                                             np.asarray(host_soft))
-                            save_image_grid(f'samples/vae/epoch{epoch}_iter{i}_hard.png',
+                            save_image_grid(f'samples/vae/epoch{epoch}_iter{it}_hard.png',
                                             np.asarray(host_hard))
                             codes_np = np.asarray(host_codes).reshape(-1)
                             hist, _ = np.histogram(codes_np, bins=min(512, NUM_TOKENS),
@@ -343,18 +440,22 @@ def main(argv=None):
                         lr = sched.step()
                         opt_state = set_learning_rate(opt_state, lr)
 
-                    if i % 10 == 0:
+                    if it % 10 == 0:
                         # the preemption check rides the existing 10-step loss
                         # collective (multi-host stop latency <= 10 fast VAE
                         # steps, well inside any preemption grace window)
                         avg_loss, stop_poll = stopper.average_and_poll(
                             distr_backend, loss)
                         dt, t_step = time.perf_counter() - t_step, time.perf_counter()
-                        logger.step(epoch, i, avg_loss, lr,
+                        logger.step(epoch, it, avg_loss, lr,
                                     extra={'temperature': temp, 'sec_per_10steps': dt})
                     global_step += 1
+                    if args.ckpt_every > 0 and it % args.ckpt_every == 0:
+                        save_vae_managed(global_step, epoch)
                     if heartbeat is not None:
                         heartbeat.beat(global_step, epoch=epoch)
+                    # chaos rehearsal: GRAFT_FAULTS="sigterm:at_step=N"
+                    faults.maybe_kill(global_step)
                     # multi-process: the collective decision from the last
                     # 10-step poll (symmetric across processes, so the
                     # collective save below cannot deadlock); single-process:
@@ -362,10 +463,15 @@ def main(argv=None):
                     if stop_poll if jax.process_count() > 1 \
                             else stopper.requested:
                         resume_path = save_vae_model('vae.pt', epoch)
+                        # final managed checkpoint for --resume auto (no-op
+                        # if this step's cadence save already ran)
+                        save_vae_managed(global_step, epoch)
                         if distr_backend.is_root_worker():
-                            print(f'interrupted at epoch {epoch} iter {i}: resume '
+                            print(f'interrupted at epoch {epoch} iter {it}: resume '
                                   f'checkpoint written to {resume_path} '
-                                  f'(--resume_path {resume_path} to continue)')
+                                  f'(--resume_path {resume_path} to continue; '
+                                  f'--resume auto picks the newest valid '
+                                  f'managed checkpoint)')
                         interrupted = True
                         break
                 if interrupted:
