@@ -8,6 +8,17 @@ shardings.  Optimizer is optax Adam wrapped in ``inject_hyperparams`` so the
 host-side schedules (utils/schedule.py) can set the lr between steps without
 retracing — replacing torch's stateful ``ExponentialLR`` /
 ``ReduceLROnPlateau`` and the DeepSpeed engine's fused step.
+
+Training health (utils/guardrails.py): every factory takes ``health=True``
+to additionally return an on-device health vector — loss, global grad
+norm, finite flag, computed *inside* the jitted step (no host syncs in
+traced code) — and, with ``guard=True``, to suppress the optimizer update
+by ``jnp.where`` masking when the gradients are non-finite, so one
+pathological batch can never poison params/opt_state.  Health-enabled
+steps take one extra traced scalar, ``fault_scale``, multiplying the loss
+before differentiation: 1.0 in production, NaN / a spike factor under the
+``grad_nan``/``loss_spike`` GRAFT_FAULTS sites (guardrails.fault_scale_for)
+so the chaos suites poison the *real* gradients without retracing.
 """
 from __future__ import annotations
 
@@ -16,6 +27,7 @@ import jax.numpy as jnp
 import optax
 
 from .parallel.mesh import shard_map
+from .utils import guardrails
 
 
 def _adam_chain(learning_rate, grad_clip_norm=0.0):
@@ -41,21 +53,30 @@ def set_learning_rate(opt_state, lr: float):
     return opt_state
 
 
-def make_vae_train_step(vae, tx, donate: bool = True):
+def make_vae_train_step(vae, tx, donate: bool = True, health: bool = False,
+                        guard: bool = True):
     """(params, opt_state, images, rng, temp) -> (params, opt_state, loss, recons).
 
     `temp` is a traced scalar so the gumbel temperature anneal
-    (train_vae.py:211-217) never retraces.
+    (train_vae.py:211-217) never retraces.  With ``health=True`` the step
+    takes a trailing ``fault_scale`` scalar and additionally returns the
+    on-device health vector (module docstring).
     """
 
-    def train_step(params, opt_state, images, rng, temp):
+    def train_step(params, opt_state, images, rng, temp, *fault_scale):
         def loss_fn(p):
             loss, recons = vae.apply(
                 {"params": p}, images, rng=rng, return_loss=True,
                 return_recons=True, temp=temp)
+            if health:
+                loss = loss * fault_scale[0]
             return loss, recons
 
         (loss, recons), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if health:
+            params, opt_state, hv = guardrails.guarded_update(
+                tx, grads, opt_state, params, loss=loss, guard=guard)
+            return params, opt_state, loss, recons, hv
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss, recons
@@ -78,17 +99,21 @@ def _dalle_loss(dalle, params, text, codes, rng):
 
 
 def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
-                          jit: bool = True):
+                          jit: bool = True, health: bool = False,
+                          guard: bool = True):
     """DALLE step.  If `vae` is given, batches carry raw images and the
     (frozen) VAE encodes them to codes inside the step, mirroring the
     reference's in-forward `vae.get_codebook_indices` under no_grad
     (dalle_pytorch.py:459, :144-149); otherwise batches carry codes.
 
     ``jit=False`` returns the raw function (for embedding in a larger jitted
-    program, e.g. a scan-of-steps benchmark loop).
+    program, e.g. a scan-of-steps benchmark loop).  With ``health=True``
+    the step takes a trailing ``fault_scale`` scalar and additionally
+    returns the on-device health vector (module docstring).
     """
 
-    def train_step(params, opt_state, vae_params, text, images_or_codes, rng):
+    def train_step(params, opt_state, vae_params, text, images_or_codes,
+                   rng, *fault_scale):
         if vae is not None:
             codes = vae.apply({"params": vae_params}, images_or_codes,
                               method=type(vae).get_codebook_indices)
@@ -96,8 +121,15 @@ def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
         else:
             codes = images_or_codes
 
-        loss, grads = jax.value_and_grad(
-            lambda p: _dalle_loss(dalle, p, text, codes, rng))(params)
+        def loss_fn(p):
+            loss = _dalle_loss(dalle, p, text, codes, rng)
+            return loss * fault_scale[0] if health else loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        if health:
+            params, opt_state, hv = guardrails.guarded_update(
+                tx, grads, opt_state, params, loss=loss, guard=guard)
+            return params, opt_state, loss, hv
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -108,7 +140,8 @@ def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
 
 
 def make_dalle_sp_train_step(dalle, tx, mesh, dp_axis: str = "dp",
-                             donate: bool = True):
+                             donate: bool = True, health: bool = False,
+                             guard: bool = True):
     """Sequence-parallel DALLE step: the loss runs inside a ``shard_map``
     over (dp, sp) — batch sharded over ``dp_axis``, the sequence over
     ``cfg.ring_axis`` with ring/Ulysses collectives making attention exact
@@ -141,14 +174,35 @@ def make_dalle_sp_train_step(dalle, tx, mesh, dp_axis: str = "dp",
             loss = dalle.apply({"params": params}, text, codes,
                                return_loss=True, deterministic=False,
                                rngs={"dropout": rng})
+            if health:
+                # the skip decision must be COLLECTIVE: the per-shard
+                # losses are genuinely different values, so the finite
+                # flags are pmin-combined over the whole (dp, sp) mesh —
+                # every shard sees the same verdict or they would diverge
+                # (the average_and_poll pattern, on device)
+                ok = guardrails.collective_all_finite(loss, (dp_axis, axis))
+                return jax.lax.pmean(loss, dp_axis), ok
             return jax.lax.pmean(loss, dp_axis)
 
+        out_specs = (P(), P()) if health else P()
         return shard_map(
             local, mesh=mesh,
             in_specs=(P(), P(dp_axis), P(dp_axis), P()),
-            out_specs=P(), check_vma=False)(params, text, codes, rng)
+            out_specs=out_specs, check_vma=False)(params, text, codes, rng)
 
-    def train_step(params, opt_state, _vae_params, text, codes, rng):
+    def train_step(params, opt_state, _vae_params, text, codes, rng,
+                   *fault_scale):
+        if health:
+            def loss_fn(p):
+                loss, ok = global_loss(p, text, codes, rng)
+                return loss * fault_scale[0], ok
+
+            (loss, ok), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            params, opt_state, hv = guardrails.guarded_update(
+                tx, grads, opt_state, params, loss=loss, extra_ok=ok,
+                guard=guard)
+            return params, opt_state, loss, hv
         loss, grads = jax.value_and_grad(global_loss)(params, text, codes, rng)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -159,7 +213,8 @@ def make_dalle_sp_train_step(dalle, tx, mesh, dp_axis: str = "dp",
 
 def make_dalle_pp_train_step(dalle, tx, params, mesh, *,
                              num_microbatches: int, pp_axis: str = "pp",
-                             dp_axis: str = "dp", donate: bool = True):
+                             dp_axis: str = "dp", donate: bool = True,
+                             health: bool = False, guard: bool = True):
     """Pipeline-parallel DALLE step (GPipe schedule, parallel/pipeline.py).
 
     The transformer stack — where the params and FLOPs are — is cut into
@@ -190,8 +245,20 @@ def make_dalle_pp_train_step(dalle, tx, params, mesh, *,
         return dalle.apply({"params": p["outer"]}, h, text, codes,
                            method=DALLE.loss_from_hidden)
 
-    def train_step(pp_params, opt_state, _vae_params, text, codes, _rng):
-        loss, grads = jax.value_and_grad(loss_fn)(pp_params, text, codes)
+    def train_step(pp_params, opt_state, _vae_params, text, codes, _rng,
+                   *fault_scale):
+        def scaled(p, text, codes):
+            loss = loss_fn(p, text, codes)
+            return loss * fault_scale[0] if health else loss
+
+        loss, grads = jax.value_and_grad(scaled)(pp_params, text, codes)
+        if health:
+            # grads/loss here are jit-level global values (GSPMD reduces
+            # them identically on every host and stage), so the plain
+            # sentinel is already a collective decision
+            pp_params, opt_state, hv = guardrails.guarded_update(
+                tx, grads, opt_state, pp_params, loss=loss, guard=guard)
+            return pp_params, opt_state, loss, hv
         updates, opt_state = tx.update(grads, opt_state, pp_params)
         pp_params = optax.apply_updates(pp_params, updates)
         return pp_params, opt_state, loss
@@ -211,13 +278,19 @@ def pp_params_to_dense(dalle, pp_params, mesh, pp_axis: str = "pp"):
     return dense
 
 
-def make_clip_train_step(clip, tx, donate: bool = True):
-    def train_step(params, opt_state, text, images, text_mask):
+def make_clip_train_step(clip, tx, donate: bool = True, health: bool = False,
+                         guard: bool = True):
+    def train_step(params, opt_state, text, images, text_mask, *fault_scale):
         def loss_fn(p):
-            return clip.apply({"params": p}, text, images, text_mask=text_mask,
-                              return_loss=True)
+            loss = clip.apply({"params": p}, text, images,
+                              text_mask=text_mask, return_loss=True)
+            return loss * fault_scale[0] if health else loss
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
+        if health:
+            params, opt_state, hv = guardrails.guarded_update(
+                tx, grads, opt_state, params, loss=loss, guard=guard)
+            return params, opt_state, loss, hv
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -25,6 +25,7 @@ collectives), so this framework makes them first-class:
 """
 from __future__ import annotations
 
+import enum
 import json
 import os
 import signal
@@ -37,6 +38,46 @@ from typing import Optional
 
 import jax
 import numpy as np
+
+
+class ExitCode(enum.IntEnum):
+    """The process exit-code taxonomy — THE one place these numbers live.
+
+    Supervisors key restart decisions off these values (``tools/monitor.py``,
+    ``chip_babysitter.sh``'s ``BABYSIT_TRAIN_CMD`` loop, any external
+    scheduler), so they are a frozen contract: never renumber, only add
+    (``tests/test_failure.py`` pins them).
+
+    Trainer processes (train_dalle.py / train_vae.py):
+
+    * ``CLEAN`` (0) — the run completed.  ``PREEMPTED`` is deliberately an
+      alias: a graceful SIGTERM stop writes its resume checkpoint and exits
+      *cleanly*; supervisors distinguish "finished" from "preempted" by the
+      heartbeat done-marker (``Heartbeat.close(done=True)``), never by exit
+      code, so an impatient scheduler reading 0 does not re-kill the pod.
+    * ``ROLLBACK_BUDGET`` (70, EX_SOFTWARE) — the anomaly-recovery ladder
+      exhausted its ``--max_rollbacks``: the run will NOT converge by
+      relaunching; a human must read the anomaly bundles.  Terminal —
+      supervisors must not restart it.
+    * ``WEDGED`` (75, EX_TEMPFAIL) — the hung-step watchdog fired: a device
+      call or collective never returned.  Transient by definition —
+      supervisors relaunch with ``--resume auto``.
+
+    External monitor (``tools/monitor.py``):
+
+    * ``MONITOR_STALLED`` (1) — some host's heartbeat is stale/missing.
+    * ``MONITOR_NO_HEARTBEATS`` (2) — no heartbeat files at all.
+    * ``RESTART_BUDGET`` (3) — ``--restart-cmd`` budget exhausted (or
+      nothing manifest-valid to restart from).  Terminal, like 70.
+    """
+
+    CLEAN = 0
+    PREEMPTED = 0  # alias of CLEAN — see the docstring for why
+    MONITOR_STALLED = 1
+    MONITOR_NO_HEARTBEATS = 2
+    RESTART_BUDGET = 3
+    ROLLBACK_BUDGET = 70
+    WEDGED = 75
 
 
 class GracefulShutdown:

@@ -28,6 +28,14 @@ reads, ``sigterm`` in the trainers' step loops).  Actions:
   :func:`maybe_kill` turns it into a real ``SIGTERM`` to this process
   (the preemption notice, mid-training).
 
+Training-health sites (utils/guardrails.py): ``grad_nan:at_step=N`` and
+``loss_spike:at_step=N`` drive :func:`guardrails.fault_scale_for`, the
+traced loss-scale port of the health-enabled train steps (NaN poisons the
+real on-device gradients; a large finite factor lands a genuine spike);
+``step_hang:at_step=N`` (:func:`maybe_hang`) wedges the step loop inside
+the hung-step watchdog's armed window so its kill-and-relaunch path is
+rehearsed end to end.
+
 Counters are per-site and thread-safe (dataset reads run under the
 prefetching DataLoader's thread pool).  The registry is parsed lazily from
 the environment; trainers call :func:`install_from_env` at startup so
@@ -172,3 +180,21 @@ def maybe_kill(step: int) -> None:
     checkpoint-and-stop path is rehearsed end to end."""
     if "at_step" in fire("sigterm", step=step):
         signal.raise_signal(signal.SIGTERM)
+
+
+def maybe_hang(step: int, cap: float = 3600.0) -> None:
+    """The ``step_hang:at_step=N`` site: wedge the step loop at step N —
+    a device call that never returns (the DESIGN.md §6 tunnel-wedge class,
+    which raises no exception).  Sleeps inside the StepWatchdog's armed
+    window so the watchdog's stack-dump + ``ExitCode.WEDGED`` exit is what
+    ends it; ``cap`` bounds the sleep so a test that forgot to arm a
+    watchdog still terminates eventually."""
+    if "at_step" in fire("step_hang", step=step):
+        import sys
+        import time
+
+        print(f"[faults] step_hang: wedging the step loop at step {step}",
+              file=sys.stderr, flush=True)
+        deadline = time.monotonic() + cap
+        while time.monotonic() < deadline:
+            time.sleep(0.5)

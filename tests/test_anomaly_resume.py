@@ -1,0 +1,373 @@
+"""Training-health chaos: the three new GRAFT_FAULTS sites, end to end.
+
+The acceptance gate for the guardrails layer (this is also CI's
+``chaos-health`` job), mirroring tests/test_crash_resume.py for the
+*silent*-failure class:
+
+* ``grad_nan:at_step=N`` — the poisoned update is masked on device and
+  the managed checkpoint at step N is **bitwise identical** to step N-1
+  (params AND optimizer state: the skipped step never happened), the run
+  completes, and the sentinel verdict is visible in the logs;
+* ``loss_spike:at_step=N`` — under ``--health rollback`` the host-side
+  anomaly policy writes an anomaly bundle, escapes to the rollback loop,
+  relaunches with ``--resume auto`` from the newest *pre-spike* valid
+  checkpoint with the offending data window skipped and the LR backed
+  off, and the resumed run finishes with finite loss;
+* ``step_hang:at_step=N`` — run as a real subprocess: the hung-step
+  watchdog dumps stacks and exits with the documented wedge code
+  (``ExitCode.WEDGED`` = 75), and a ``tools/monitor.py --restart-cmd``
+  supervisor pass relaunches with ``--resume auto`` to completion.
+
+In-process where possible (same pattern as test_crash_resume.py: shared
+in-process executables make reruns cheap); the wedge path needs a real
+process because the watchdog's exit is ``os._exit``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dalle_pytorch_tpu.utils.failure import ExitCode  # noqa: E402
+
+VOCAB_WORDS = ["red", "green", "blue", "yellow", "circle", "square", "bird",
+               "a", "the", "of"]
+HPARAMS = dict(BATCH_SIZE=4, MODEL_DIM=32, TEXT_SEQ_LEN=8, DEPTH=2,
+               HEADS=2, DIM_HEAD=16, ATTN_TYPES=["full", "axial_row"])
+# 12 pairs / batch 4 = 3 steps per epoch; global step s is epoch s//3,
+# iter s%3 (1-based steps).
+
+
+@pytest.fixture(scope="module")
+def tiny_tokenizer_json(tmp_path_factory):
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {"[UNK]": 0}
+    for w in VOCAB_WORDS:
+        vocab[w] = len(vocab)
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    path = tmp_path_factory.mktemp("tok") / "tiny_tokenizer.json"
+    tok.save(str(path))
+    return path
+
+
+@pytest.fixture(scope="module")
+def tiny_dataset(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    folder = tmp_path_factory.mktemp("data")
+    from PIL import Image
+
+    for i in range(12):
+        img = (rng.uniform(size=(24, 24, 3)) * 255).astype(np.uint8)
+        Image.fromarray(img).save(folder / f"sample_{i}.png")
+        words = rng.choice(VOCAB_WORDS, size=3, replace=True)
+        (folder / f"sample_{i}.txt").write_text(" ".join(words) + "\n")
+    return folder
+
+
+@pytest.fixture(scope="module")
+def tiny_vae_ckpt(tmp_path_factory):
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import DiscreteVAE, VAEConfig
+    from dalle_pytorch_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = VAEConfig(image_size=16, num_layers=2, num_tokens=32,
+                    codebook_dim=16, hidden_dim=16, num_resnet_blocks=0)
+    vae = DiscreteVAE(cfg)
+    k = jax.random.PRNGKey(7)
+    params = vae.init({"params": k, "gumbel": k},
+                      jnp.zeros((1, 16, 16, 3)))["params"]
+    path = tmp_path_factory.mktemp("vae") / "vae.pt"
+    save_checkpoint(path, {"hparams": cfg.to_dict(),
+                           "weights": jax.device_get(params)})
+    return path
+
+
+def run_train(workdir, data, vae, tok, extra_args, faults_spec=None,
+              epochs=4):
+    env_before = os.environ.get("GRAFT_FAULTS")
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps(HPARAMS)
+    if faults_spec is None:
+        os.environ.pop("GRAFT_FAULTS", None)
+    else:
+        os.environ["GRAFT_FAULTS"] = faults_spec
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        import train_dalle
+
+        train_dalle.main(["--image_text_folder", str(data),
+                          "--bpe_path", str(tok),
+                          "--truncate_captions",
+                          "--learning_rate", "1e-3",
+                          "--epochs", str(epochs)]
+                         + (["--vae_path", str(vae)] if vae else [])
+                         + extra_args)
+    finally:
+        os.chdir(cwd)
+        del os.environ["DALLE_TPU_HPARAMS"]
+        if env_before is None:
+            os.environ.pop("GRAFT_FAULTS", None)
+        else:
+            os.environ["GRAFT_FAULTS"] = env_before
+    from dalle_pytorch_tpu.utils import faults as faults_mod
+
+    faults_mod.reset()  # never leak an armed registry into the next run
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _leaves(tree[k])
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _leaves(v)
+    elif hasattr(tree, "shape"):
+        yield tree
+
+
+def _assert_bitwise_equal(a, b):
+    a_leaves, b_leaves = list(_leaves(a)), list(_leaves(b))
+    assert len(a_leaves) == len(b_leaves)
+    for x, y in zip(a_leaves, b_leaves):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_grad_nan_step_is_masked_bitwise(tiny_dataset, tiny_vae_ckpt,
+                                         tiny_tokenizer_json,
+                                         tmp_path_factory, capfd):
+    """A NaN gradient at step 8: the on-device sentinel suppresses the
+    update, so the managed checkpoint AT step 8 equals step 7 bitwise in
+    both params and optimizer state — and the run still completes."""
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+    from dalle_pytorch_tpu.utils.ckpt_manager import verify
+
+    wd = tmp_path_factory.mktemp("nan_run")
+    run_train(wd, tiny_dataset, tiny_vae_ckpt, tiny_tokenizer_json,
+              ["--ckpt_every", "1", "--keep_checkpoints", "16"],
+              faults_spec="grad_nan:at_step=8")
+    assert (wd / "dalle-final.pt").exists()  # the NaN did not kill the run
+    err = capfd.readouterr().err
+    assert "step 8: nonfinite" in err  # the sentinel reported the skip
+
+    ckpts = wd / "checkpoints"
+    before = verify(ckpts / "ckpt-00000007")
+    after = verify(ckpts / "ckpt-00000008")
+    assert before is not None and after is not None
+    c7 = load_checkpoint(before.payload)
+    c8 = load_checkpoint(after.payload)
+    # bitwise: the poisoned step left params AND opt_state untouched
+    # (the Adam step count did not advance either)
+    for key in ("weights", "opt_state"):
+        _assert_bitwise_equal(c7[key], c8[key])
+    # ...while an ordinary step really does change both
+    c9 = load_checkpoint(verify(ckpts / "ckpt-00000009").payload)
+    assert not np.array_equal(
+        next(iter(_leaves(c8["weights"]))), next(iter(_leaves(c9["weights"]))))
+    # the final weights are finite — the NaN never propagated
+    final = load_checkpoint(wd / "dalle-final.pt")
+    for leaf in _leaves(final["weights"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_loss_spike_rolls_back_and_completes(tiny_dataset, tiny_vae_ckpt,
+                                             tiny_tokenizer_json,
+                                             tmp_path_factory, capfd):
+    """A finite loss spike at step 14 under --health rollback: the anomaly
+    policy fires before the spiked state reaches a checkpoint (the flush
+    precedes save_managed), writes the anomaly bundle, and the rollback
+    loop relaunches with --resume auto from the pre-spike step 13, skips
+    the offending window, backs off the LR, and finishes finite."""
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    wd = tmp_path_factory.mktemp("spike_run")
+    run_train(wd, tiny_dataset, tiny_vae_ckpt, tiny_tokenizer_json,
+              ["--ckpt_every", "1", "--keep_checkpoints", "32",
+               "--health", "rollback", "--max_rollbacks", "2"],
+              faults_spec="loss_spike:at_step=14", epochs=6)
+    out, err = capfd.readouterr()
+    assert (wd / "dalle-final.pt").exists()
+    assert "step 14: spike" in err  # classified by the robust z-score
+    # the escalation ladder ran: bundle -> rollback relaunch -> lr backoff
+    bundle = wd / "checkpoints" / "anomaly-00000014"
+    assert bundle.exists()
+    report = json.loads((bundle / "report.json").read_text())
+    assert report["reason"] == "spike" and report["step"] == 14
+    assert report["loss"] > 100 * max(report["loss_history"])
+    assert "rollback 1/2" in err
+    # resumed from the newest PRE-spike checkpoint, skipping the window
+    assert "auto-resume: step 13" in out
+    assert "skipping the data window through step 14" in out
+    assert "rollback lr backoff" in out
+    # and the relaunched run reached the configured epoch count, finite
+    final = load_checkpoint(wd / "dalle-final.pt")
+    assert int(final["epoch"]) == 6
+    assert int(final["global_step"]) == 18
+    for leaf in _leaves(final["weights"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_vae_grad_nan_masked_too(tiny_dataset, tmp_path_factory, capfd):
+    """train_vae carries the same sentinel: a NaN gradient at step 3 leaves
+    the step-3 managed checkpoint bitwise equal to step 2."""
+    import train_vae
+    from dalle_pytorch_tpu.utils import faults as faults_mod
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+    from dalle_pytorch_tpu.utils.ckpt_manager import verify
+
+    wd = tmp_path_factory.mktemp("vae_nan")
+    hparams = dict(EPOCHS=2, BATCH_SIZE=4, NUM_TOKENS=32, NUM_LAYERS=2,
+                   NUM_RESNET_BLOCKS=0, EMB_DIM=16, HID_DIM=16)
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps(hparams)
+    os.environ["GRAFT_FAULTS"] = "grad_nan:at_step=3"
+    cwd = os.getcwd()
+    os.chdir(wd)
+    try:
+        train_vae.main(["--image_folder", str(tiny_dataset),
+                        "--image_size", "16", "--ckpt_every", "1",
+                        "--keep_checkpoints", "8"])
+    finally:
+        os.chdir(cwd)
+        del os.environ["DALLE_TPU_HPARAMS"]
+        os.environ.pop("GRAFT_FAULTS", None)
+        faults_mod.reset()
+    assert (wd / "vae-final.pt").exists()
+    assert "step 3: nonfinite" in capfd.readouterr().err
+    ckpts = wd / "checkpoints"
+    c2 = load_checkpoint(verify(ckpts / "ckpt-00000002").payload)
+    c3 = load_checkpoint(verify(ckpts / "ckpt-00000003").payload)
+    for key in ("weights", "opt_state"):
+        _assert_bitwise_equal(c2[key], c3[key])
+
+
+def test_vae_loss_spike_rolls_back_pre_spike(tiny_dataset, tmp_path_factory,
+                                             capfd):
+    """train_vae's rollback ladder, and the save-ordering invariant: the
+    health observation runs BEFORE the managed save, so the spiked state
+    never reaches a manifest and the rollback target is pre-spike."""
+    import train_vae
+    from dalle_pytorch_tpu.utils import faults as faults_mod
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+    from dalle_pytorch_tpu.utils.ckpt_manager import latest_valid
+
+    wd = tmp_path_factory.mktemp("vae_spike")
+    hparams = dict(EPOCHS=6, BATCH_SIZE=4, NUM_TOKENS=32, NUM_LAYERS=2,
+                   NUM_RESNET_BLOCKS=0, EMB_DIM=16, HID_DIM=16)
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps(hparams)
+    os.environ["GRAFT_FAULTS"] = "loss_spike:at_step=14"
+    cwd = os.getcwd()
+    os.chdir(wd)
+    try:
+        train_vae.main(["--image_folder", str(tiny_dataset),
+                        "--image_size", "16", "--ckpt_every", "1",
+                        "--keep_checkpoints", "32",
+                        "--health", "rollback", "--max_rollbacks", "2"])
+    finally:
+        os.chdir(cwd)
+        del os.environ["DALLE_TPU_HPARAMS"]
+        os.environ.pop("GRAFT_FAULTS", None)
+        faults_mod.reset()
+    out, err = capfd.readouterr()
+    assert "step 14: spike" in err
+    assert "rollback 1/2" in err
+    # never checkpointed the spiked state: step 14's save did not happen,
+    # so the relaunch resumed from the pre-spike step 13
+    assert not (wd / "checkpoints" / "ckpt-00000014").exists()
+    assert "auto-resume: step 13" in out
+    assert (wd / "checkpoints" / "anomaly-00000014").exists()
+    final = load_checkpoint(wd / "vae-final.pt")
+    assert int(final["epoch"]) == 6
+    for leaf in _leaves(final["weights"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # the post-rollback checkpoints continued past the skipped window
+    assert latest_valid(wd / "checkpoints").step == 18
+
+
+def _subprocess_env(workdir, faults_spec=None):
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        DALLE_TPU_HPARAMS=json.dumps(HPARAMS),
+    )
+    env.pop("GRAFT_FAULTS", None)
+    if faults_spec is not None:
+        env["GRAFT_FAULTS"] = faults_spec
+    return env
+
+
+def test_step_hang_wedge_exit_and_supervised_resume(tiny_dataset,
+                                                    tiny_vae_ckpt,
+                                                    tiny_tokenizer_json,
+                                                    tmp_path_factory,
+                                                    capsys):
+    """step_hang wedges the loop at step 5 inside the watchdog's armed
+    window (a real subprocess — the watchdog's exit is os._exit): the
+    process dies with ExitCode.WEDGED (75) after dumping stacks, and one
+    tools/monitor.py --restart-cmd supervisor pass relaunches it with
+    --resume auto from the newest valid checkpoint to completion."""
+    wd = tmp_path_factory.mktemp("wedge_run")
+    hb = wd / "hb"
+    base_cmd = [sys.executable, str(REPO / "train_dalle.py"),
+                "--image_text_folder", str(tiny_dataset),
+                "--bpe_path", str(tiny_tokenizer_json),
+                "--truncate_captions", "--learning_rate", "1e-3",
+                "--epochs", "4", "--vae_path", str(tiny_vae_ckpt),
+                "--ckpt_every", "2", "--keep_checkpoints", "8",
+                "--heartbeat_dir", str(hb)]
+
+    # phase 1: the run wedges at step 5; the watchdog (deadline 3s, step 1
+    # compile-exempt) must end it with the documented wedge code
+    wedged = subprocess.run(
+        base_cmd + ["--step_deadline", "3"], cwd=wd, timeout=900,
+        env=_subprocess_env(wd, "step_hang:at_step=5"),
+        capture_output=True, text=True)
+    assert wedged.returncode == int(ExitCode.WEDGED) == 75, wedged.stderr[-3000:]
+    assert "hung step" in wedged.stderr  # the watchdog announced itself
+    # ...and the stack dump shows WHERE it wedged (the post-mortem)
+    assert "maybe_hang" in wedged.stderr
+    assert not (wd / "dalle-final.pt").exists()
+
+    # phase 2: the supervisor treats 75 as restart-with-resume — one
+    # monitor scan sees the stale heartbeat (no done marker) and relaunches
+    sys.path.insert(0, str(REPO / "tools"))
+    import monitor
+
+    restart_log = wd / "restart.log"
+    cmd = (" ".join(f"'{a}'" for a in base_cmd)
+           + f" --resume auto > '{restart_log}' 2>&1")
+    saved_env = {k: os.environ.get(k) for k in
+                 ("DALLE_TPU_HPARAMS", "GRAFT_FAULTS")}
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps(HPARAMS)
+    os.environ.pop("GRAFT_FAULTS", None)
+    cwd = os.getcwd()
+    os.chdir(wd)
+    try:
+        code = monitor.main([str(hb), "--timeout", "1",
+                             "--restart-cmd", cmd,
+                             "--ckpt-dir", str(wd / "checkpoints")])
+    finally:
+        os.chdir(cwd)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert code == 1  # the scan itself reported the stall that fired it
+    scan = capsys.readouterr().out
+    # the health extras rode the wedged run's beats into the scan output
+    assert "loss" in scan
+    out = restart_log.read_text()
+    assert "auto-resume: step 4" in out  # newest valid pre-wedge ckpt
+    assert (wd / "dalle-final.pt").exists()  # the relaunch completed

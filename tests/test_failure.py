@@ -12,7 +12,29 @@ import json
 import signal
 import time
 
-from dalle_pytorch_tpu.utils.failure import GracefulShutdown, Heartbeat
+from dalle_pytorch_tpu.utils.failure import (ExitCode, GracefulShutdown,
+                                             Heartbeat)
+
+
+def test_exit_code_taxonomy_is_frozen():
+    """The ExitCode enum is THE one place the supervisor contract lives
+    (tools/monitor.py, chip_babysitter.sh's BABYSIT_TRAIN_CMD loop, any
+    external scheduler key restart decisions off these values) — pin every
+    number so a renumbering can never slip through a refactor."""
+    assert int(ExitCode.CLEAN) == 0
+    # a graceful preemption stop exits CLEANLY (supervisors tell "finished"
+    # from "preempted" by the heartbeat done-marker, never by exit code)
+    assert int(ExitCode.PREEMPTED) == 0
+    assert ExitCode.PREEMPTED is ExitCode.CLEAN  # a true alias
+    assert int(ExitCode.MONITOR_STALLED) == 1
+    assert int(ExitCode.MONITOR_NO_HEARTBEATS) == 2
+    assert int(ExitCode.RESTART_BUDGET) == 3
+    assert int(ExitCode.ROLLBACK_BUDGET) == 70  # terminal: never restart
+    assert int(ExitCode.WEDGED) == 75  # transient: restart with --resume
+    # the trainer-side codes must never collide with the monitor's own
+    assert len({ExitCode.MONITOR_STALLED, ExitCode.MONITOR_NO_HEARTBEATS,
+                ExitCode.RESTART_BUDGET, ExitCode.ROLLBACK_BUDGET,
+                ExitCode.WEDGED, ExitCode.CLEAN}) == 6
 
 
 def test_graceful_shutdown_sets_flag_on_signal():
@@ -268,6 +290,89 @@ def test_monitor_restart_cmd_and_budget(tmp_path, capsys):
     assert code == 3
     assert marker.read_text().count("r") == 2
     capsys.readouterr()  # drain scan output
+
+
+def test_monitor_flags_unhealthy_heartbeats(tmp_path, capsys):
+    """The trainers ride loss/grad_norm/health_state on every beat
+    (guardrails.HealthMonitor.beat_extras); the monitor prints them and
+    flags non-finite values and non-ok verdicts so an operator sees a
+    sick run without reading training logs."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import monitor
+
+    hb = Heartbeat(tmp_path)
+    try:
+        hb.beat(11, loss=2.125, grad_norm=0.5, health_state="ok")
+    finally:
+        hb.close()
+    assert monitor.main([str(tmp_path), "--timeout", "300"]) == 0
+    out = capsys.readouterr().out
+    # healthy: values printed, no flag
+    assert "loss 2.125" in out and "grad_norm 0.5" in out
+    assert "UNHEALTHY" not in out
+
+    hb2 = Heartbeat(tmp_path)
+    try:
+        hb2._last_write = None  # force the write through the rate limit
+        hb2.beat(12, loss=float("nan"), grad_norm=float("inf"),
+                 health_state="spike")
+    finally:
+        hb2.close()
+    assert monitor.main([str(tmp_path), "--timeout", "300"]) == 0  # alive...
+    out = capsys.readouterr().out
+    assert "UNHEALTHY: spike" in out  # ...but visibly sick
+    assert "loss=nan" in out and "grad_norm=inf" in out
+
+
+def test_monitor_restart_stops_on_terminal_exit_code(tmp_path, capsys):
+    """A restarted trainer exiting ExitCode.ROLLBACK_BUDGET (70) means
+    automatic recovery will not converge: the monitor must stop
+    immediately (exit RESTART_BUDGET) instead of burning the remaining
+    budget relaunching the same divergence.  A WEDGED (75) exit is
+    transient and consumes the budget like any other death."""
+    import sys as _sys
+    from pathlib import Path
+
+    import numpy as np
+
+    _sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import monitor
+
+    from dalle_pytorch_tpu.utils.ckpt_manager import CheckpointManager
+
+    hb = Heartbeat(tmp_path)
+    hb.beat(5)
+    hb.close()
+    payload = json.loads(hb.path.read_text())
+    payload["time"] -= 1000  # stalled
+    hb.path.write_text(json.dumps(payload))
+    ckpts = tmp_path / "ckpts"
+    CheckpointManager(ckpts).save(
+        9, {"weights": {"w": np.zeros((2,), np.float32)}})
+
+    marker = tmp_path / "restarts.log"
+    # terminal: the first restart exits 70 and the loop stops right there,
+    # with most of the --max-restarts 5 budget unspent
+    code = monitor.main([str(tmp_path), "--timeout", "300",
+                         "--watch", "0.01", "--max-restarts", "5",
+                         "--restart-cmd", f"echo r >> {marker}; exit 70",
+                         "--ckpt-dir", str(ckpts)])
+    assert code == int(ExitCode.RESTART_BUDGET) == 3
+    assert marker.read_text().count("r") == 1
+    assert "rollback budget exhausted" in capsys.readouterr().err
+
+    # transient: rc=75 keeps relaunching until the budget runs out
+    marker.unlink()
+    code = monitor.main([str(tmp_path), "--timeout", "300",
+                         "--watch", "0.01", "--max-restarts", "2",
+                         "--restart-cmd", f"echo r >> {marker}; exit 75",
+                         "--ckpt-dir", str(ckpts)])
+    assert code == int(ExitCode.RESTART_BUDGET)
+    assert marker.read_text().count("r") == 2
+    assert "hung-step watchdog" in capsys.readouterr().err
 
 
 def test_watchdog_quiet_before_first_step(tmp_path, capfd):

@@ -112,6 +112,104 @@ def test_pp_train_step_matches_dense():
                                              mesh)) < 2e-5
 
 
+def _bitwise_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def test_health_sentinel_dense_dp_masks_nonfinite():
+    """The health-enabled dense/dp step: a NaN gradient (injected through
+    the traced fault_scale port, exactly as GRAFT_FAULTS does) suppresses
+    the update — params AND opt_state bitwise unchanged — while a clean
+    step applies and reports applied=1."""
+    cfg, model, params, tx, text, codes = _setup()
+    opt = jax.jit(tx.init)(params)
+    rng = jax.random.PRNGKey(7)
+    step = make_dalle_train_step(model, tx, donate=False, health=True)
+
+    p1, o1, loss, hv = step(params, opt, None, text, codes, rng,
+                            jnp.float32(1.0))
+    assert float(hv["applied"]) == 1.0 and np.isfinite(float(loss))
+    assert not _bitwise_equal(params, p1)
+
+    p2, o2, _, hv2 = step(params, opt, None, text, codes, rng,
+                          jnp.float32(jnp.nan))
+    assert float(hv2["applied"]) == 0.0
+    assert _bitwise_equal(params, p2) and _bitwise_equal(opt, o2)
+
+    # the healthy path is numerically identical to the health-off step:
+    # the sentinel observes, it never perturbs
+    step_plain = make_dalle_train_step(model, tx, donate=False)
+    pp_, _, loss_plain = step_plain(params, opt, None, text, codes, rng)
+    assert float(loss) == float(loss_plain)
+    assert _bitwise_equal(p1, pp_)
+
+
+@pytest.mark.parametrize("sp_impl,sp", [("ring", 4), ("ulysses", 2)])
+def test_health_sentinel_sp_collective_skip(sp_impl, sp):
+    """Under sequence parallelism the local losses are genuinely
+    per-shard, so the finite flags are pmin-combined across the (dp, sp)
+    mesh before anyone decides: a poisoned step skips on ALL shards and
+    the returned health scalars are mesh-replicated (every host reads the
+    identical verdict)."""
+    cfg, dense, params, tx, text, codes = _setup()
+    opt = jax.jit(tx.init)(params)
+    rng = jax.random.PRNGKey(7)
+    sp_cfg = dataclasses.replace(cfg, ring_axis="sp", sp_impl=sp_impl,
+                                 sp_size=sp)
+    mesh = make_mesh(sp=sp, devices=jax.devices()[:8])
+    step = make_dalle_sp_train_step(DALLE(sp_cfg), tx, mesh, donate=False,
+                                    health=True)
+    with mesh:
+        p1, _, loss, hv = step(params, opt, None, text, codes, rng,
+                               jnp.float32(1.0))
+        p2, o2, _, hv2 = step(params, opt, None, text, codes, rng,
+                              jnp.float32(jnp.nan))
+    assert float(hv["applied"]) == 1.0
+    assert not _bitwise_equal(params, p1)
+    # the clean health-enabled sp step still matches the dense step
+    step_d = make_dalle_train_step(dense, tx, donate=False)
+    pd, _, loss_d = step_d(params, opt, None, text, codes, rng)
+    assert np.isclose(float(loss_d), float(loss), rtol=2e-5, atol=2e-6)
+    assert _max_delta(pd, p1) < 2e-5
+
+    # poisoned: skipped on every shard — the full sharded trees are
+    # bitwise equal to the inputs, not just their replicated views
+    assert float(hv2["applied"]) == 0.0
+    assert _bitwise_equal(jax.device_get(params), jax.device_get(p2))
+    assert _bitwise_equal(jax.device_get(opt), jax.device_get(o2))
+    # the verdict itself is replicated across the whole virtual mesh
+    for v in hv2.values():
+        assert v.sharding.is_fully_replicated
+
+
+@pytest.mark.slow
+def test_health_sentinel_pp_skip():
+    """Pipeline parallelism: grads/loss are jit-level global values (GSPMD
+    reduces them identically on every stage), so the plain sentinel is
+    already collective — a poisoned microbatched step leaves every stage's
+    param slice bitwise untouched."""
+    cfg, model, params, tx, text, codes = _setup(dict(depth=4), batch=8)
+    rng = jax.random.PRNGKey(7)
+    mesh = make_mesh(pp=2, devices=jax.devices()[:8])
+    step, pp_params = make_dalle_pp_train_step(
+        model, tx, params, mesh, num_microbatches=2, donate=False,
+        health=True)
+    opt = jax.jit(tx.init)(pp_params)
+    with mesh:
+        p1, _, loss, hv = step(pp_params, opt, None, text, codes, rng,
+                               jnp.float32(1.0))
+        p2, o2, _, hv2 = step(pp_params, opt, None, text, codes, rng,
+                              jnp.float32(jnp.nan))
+    assert float(hv["applied"]) == 1.0 and np.isfinite(float(loss))
+    assert not _bitwise_equal(pp_params, p1)
+    assert float(hv2["applied"]) == 0.0
+    assert _bitwise_equal(jax.device_get(pp_params), jax.device_get(p2))
+    assert _bitwise_equal(jax.device_get(opt), jax.device_get(o2))
+    for v in hv2.values():
+        assert v.sharding.is_fully_replicated
+
+
 @pytest.mark.slow
 def test_moe_train_step_learns_and_counts_aux():
     """The MoE step carries the sown load-balance aux in its loss (a plain

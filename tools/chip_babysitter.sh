@@ -134,16 +134,30 @@ echo "$(date +%T) contract check PASS"
 # torn final write; stalled-or-dead per tools/monitor.py heartbeat scan ->
 # kill + relaunch, bounded by BABYSIT_MAX_RESTARTS.  Inactive when the env
 # var is unset, so the measurement queue below is unaffected.
+#
+# Exit-code taxonomy (dalle_pytorch_tpu/utils/failure.py ExitCode — the
+# frozen supervisor contract): 0 = clean OR a graceful preemption stop
+# (distinguished by the heartbeat done-marker, never by exit code);
+# 75 (WEDGED) = the hung-step watchdog fired on a device call that never
+# returned — transient by definition, relaunch with --resume auto;
+# 70 (ROLLBACK_BUDGET) = the anomaly-recovery ladder exhausted
+# --max_rollbacks — TERMINAL, a relaunch replays the same divergence, so
+# never restart it: a human must read the anomaly bundles.
+# BABYSIT_STEP_DEADLINE > 0 arms the trainer's in-process hung-step
+# watchdog (--step_deadline) so a wedge inside a device call turns into
+# the rc=75 relaunch instead of waiting out the heartbeat stall scan.
 if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
   BABYSIT_HB_DIR=${BABYSIT_HB_DIR:-${CHIP_TMP}/train_hb}
   BABYSIT_MAX_RESTARTS=${BABYSIT_MAX_RESTARTS:-3}
   BABYSIT_STALL_TIMEOUT=${BABYSIT_STALL_TIMEOUT:-600}
   BABYSIT_POLL=${BABYSIT_POLL:-60}
+  BABYSIT_STEP_DEADLINE=${BABYSIT_STEP_DEADLINE:-0}
   (
     restarts=0
     while :; do
       echo "$(date +%T) train supervisor: launch (restarts so far: $restarts/${BABYSIT_MAX_RESTARTS})"
       ${BABYSIT_TRAIN_CMD} --resume auto --heartbeat_dir "${BABYSIT_HB_DIR}" \
+        --step_deadline "${BABYSIT_STEP_DEADLINE}" \
         >> "${CHIP_TMP}/train_run.log" 2>&1 &
       train_pid=$!
       while kill -0 "$train_pid" 2>/dev/null; do
@@ -165,11 +179,19 @@ if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
       if [ "$rc" -eq 0 ]; then
         echo "$(date +%T) train supervisor: run exited cleanly"; break
       fi
+      if [ "$rc" -eq 70 ]; then  # ExitCode.ROLLBACK_BUDGET: terminal
+        echo "$(date +%T) train supervisor: rc=70 rollback budget exhausted — NOT restarting (automatic recovery will not converge; read the anomaly bundles)"
+        break
+      fi
       restarts=$((restarts+1))
       if [ "$restarts" -gt "$BABYSIT_MAX_RESTARTS" ]; then
         echo "$(date +%T) train supervisor: restart budget exhausted"; break
       fi
-      echo "$(date +%T) train supervisor: rc=$rc — restarting from the last good checkpoint"
+      if [ "$rc" -eq 75 ]; then  # ExitCode.WEDGED: transient, resume
+        echo "$(date +%T) train supervisor: rc=75 hung-step watchdog — relaunching with --resume auto"
+      else
+        echo "$(date +%T) train supervisor: rc=$rc — restarting from the last good checkpoint"
+      fi
     done
   ) &
   TRAIN_SUP_PID=$!

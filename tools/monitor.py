@@ -14,15 +14,29 @@ auto``, which resumes from the newest manifest-valid managed checkpoint,
 falling back past torn ones), bounded by ``--max-restarts``.  When
 ``--ckpt-dir`` is given the restart only fires if that directory holds a
 manifest-valid checkpoint, and ``{ckpt}`` in the command expands to its
-payload path.
+payload path.  A foreground restart command that exits with the trainer's
+``ExitCode.ROLLBACK_BUDGET`` (70) stops the babysitter immediately —
+that code means automatic recovery will NOT converge (a human must read
+the anomaly bundles), so burning the remaining restart budget on it would
+just produce more bundles.  ``ExitCode.WEDGED`` (75, the hung-step
+watchdog) is transient by definition and consumes one restart like any
+other death.
+
+The trainers ride their health extras (``loss``, ``grad_norm``,
+``health_state`` — see utils/guardrails.py) on every heartbeat, and the
+scan prints them, flagging non-finite values and non-``ok`` verdicts with
+an ``UNHEALTHY`` marker — an operator sees a sick run here without
+reading training logs.
 
 Usage:
     python tools/monitor.py HEARTBEAT_DIR [--timeout 300] [--expect N] [--watch S]
     python tools/monitor.py hb --watch 60 --ckpt-dir checkpoints \
         --restart-cmd 'nohup python train_dalle.py --resume auto ... &'
 
-Exit codes: 0 all hosts healthy, 1 stalled/missing hosts, 2 no heartbeats,
-3 restart budget exhausted (or nothing valid to restart from).
+Exit codes (the ``ExitCode`` taxonomy in utils/failure.py): 0 all hosts
+healthy, 1 stalled/missing hosts, 2 no heartbeats, 3 restart budget
+exhausted (or nothing valid to restart from, or a terminal rc=70 from the
+restarted trainer).
 """
 from __future__ import annotations
 
@@ -37,12 +51,30 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 from dalle_pytorch_tpu.cli import apply_platform_env  # noqa: E402
-from dalle_pytorch_tpu.utils.failure import Heartbeat  # noqa: E402
+from dalle_pytorch_tpu.utils.failure import ExitCode, Heartbeat  # noqa: E402
 
 # the monitor itself never needs a device, but an accidental backend
 # query downstream must honor JAX_PLATFORMS=cpu instead of hanging on a
 # pinned-but-down tunnel (BACKEND001 contract)
 apply_platform_env()
+
+
+def _health_flag(info: dict) -> str | None:
+    """Operator-visible sickness from the health extras the trainers ride
+    on every beat (guardrails.HealthMonitor.beat_extras): a non-``ok``
+    verdict, or a non-finite loss/grad_norm (belt-and-braces — a verdict
+    should already cover it, but a half-wired trainer must still flag)."""
+    import math
+
+    bits = []
+    state = info.get("health_state")
+    if state and state != "ok":
+        bits.append(str(state))
+    for key in ("loss", "grad_norm"):
+        value = info.get(key)
+        if value is not None and not math.isfinite(float(value)):
+            bits.append(f"{key}={value}")
+    return " ".join(bits) or None
 
 
 def scan(directory: Path, timeout: float, expect: int | None) -> int:
@@ -55,7 +87,7 @@ def scan(directory: Path, timeout: float, expect: int | None) -> int:
         if (m := re.fullmatch(r"heartbeat-p(\d+)", p.stem)))
     if not files:
         print(f"no heartbeat files in {directory}", file=sys.stderr)
-        return 2
+        return int(ExitCode.MONITOR_NO_HEARTBEATS)
 
     now = time.time()
     bad = 0
@@ -64,18 +96,24 @@ def scan(directory: Path, timeout: float, expect: int | None) -> int:
         seen.add(proc)
         stalled = Heartbeat.is_stalled(path, timeout, now=now)
         done = False
+        sick = None
         try:
             info = Heartbeat.read(path)
             done = bool(info.get("done"))
             age = now - info["time"]
             detail = f"step {info.get('step', '?')} age {age:.0f}s"
+            for key in ("loss", "grad_norm"):
+                if info.get(key) is not None:
+                    detail += f" {key} {float(info[key]):.5g}"
+            sick = _health_flag(info)
         # graftlint: disable=EXC001 (a heartbeat mid-write is expected; any parse error = torn file, reported as status below)
         except Exception:
             detail = "unreadable (torn write?)"
         # a finished run's heartbeat ages forever — that's completion, not
         # death, and must not trigger an auto-restart wrapper
         status = "done" if done else ("STALLED" if stalled else "ok")
-        print(f"process {proc}: {status} ({detail})")
+        flag = f"  << UNHEALTHY: {sick}" if sick and not done else ""
+        print(f"process {proc}: {status} ({detail}){flag}")
         bad += stalled and not done
 
     if expect is not None:
@@ -83,7 +121,7 @@ def scan(directory: Path, timeout: float, expect: int | None) -> int:
         for proc in sorted(missing):
             print(f"process {proc}: MISSING (never wrote a heartbeat)")
         bad += len(missing)
-    return 1 if bad else 0
+    return int(ExitCode.MONITOR_STALLED) if bad else int(ExitCode.CLEAN)
 
 
 def main(argv=None) -> int:
@@ -121,7 +159,7 @@ def main(argv=None) -> int:
         if restarts >= args.max_restarts:
             print(f"restart budget exhausted ({args.max_restarts}); "
                   "giving up", file=sys.stderr)
-            return 3
+            return int(ExitCode.RESTART_BUDGET)
         cmd = args.restart_cmd
         if args.ckpt_dir is not None:
             from dalle_pytorch_tpu.utils.ckpt_manager import latest_valid
@@ -130,19 +168,31 @@ def main(argv=None) -> int:
             if info is None:
                 print(f"no manifest-valid checkpoint under {args.ckpt_dir}; "
                       "nothing to restart from", file=sys.stderr)
-                return 3
+                return int(ExitCode.RESTART_BUDGET)
             cmd = cmd.replace("{ckpt}", str(info.payload))
         print(f"restart {restarts + 1}/{args.max_restarts}: {cmd}",
               file=sys.stderr)
-        subprocess.run(cmd, shell=True)
+        rc = subprocess.run(cmd, shell=True).returncode
+        if rc == int(ExitCode.ROLLBACK_BUDGET):
+            # terminal by contract: the trainer's anomaly-recovery ladder
+            # gave up — a relaunch reruns the same divergence, so stop
+            # here instead of burning the rest of the budget on it
+            print(f"restarted trainer exited {rc} (rollback budget "
+                  "exhausted) — terminal, a human must read the anomaly "
+                  "bundles; giving up", file=sys.stderr)
+            return int(ExitCode.RESTART_BUDGET)
+        if rc == int(ExitCode.WEDGED):
+            print(f"restarted trainer exited {rc} (hung-step watchdog) — "
+                  "transient, will relaunch on the next stalled scan",
+                  file=sys.stderr)
         return None
 
-    code = 2
+    code = int(ExitCode.MONITOR_NO_HEARTBEATS)
     restarts = 0
     try:
         while True:
             code = scan(args.heartbeat_dir, args.timeout, args.expect)
-            if args.restart_cmd and code == 1:
+            if args.restart_cmd and code == int(ExitCode.MONITOR_STALLED):
                 stop = try_restart(restarts)
                 if stop is not None:
                     return stop

@@ -39,7 +39,7 @@ from dalle_pytorch_tpu.models.dalle import generate_codes
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
 from dalle_pytorch_tpu.training import (make_dalle_train_step, make_optimizer,
                                         set_learning_rate)
-from dalle_pytorch_tpu.utils import faults
+from dalle_pytorch_tpu.utils import faults, guardrails
 from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 from dalle_pytorch_tpu.utils.ckpt_manager import (CheckpointManager,
                                                   config_fingerprint)
@@ -91,6 +91,33 @@ def parse_args(argv=None):
                         help='warn on stderr when no step completes for this '
                              'many seconds (0 disables the in-process '
                              'watchdog); requires --heartbeat_dir')
+    parser.add_argument('--health', choices=('off', 'warn', 'skip',
+                                             'rollback'), default='skip',
+                        help='training-health guardrails: every step '
+                             'computes an on-device health vector (loss, '
+                             'grad norm, finite flag). warn: observe only; '
+                             'skip (default): additionally mask the update '
+                             'when grads are non-finite so params/optimizer '
+                             'are never poisoned; rollback: additionally '
+                             'roll back to the newest valid managed '
+                             'checkpoint on loss spikes / divergence, '
+                             'skipping the offending data window with an '
+                             'LR backoff, bounded by --max_rollbacks')
+    parser.add_argument('--step_deadline', type=float, default=0,
+                        help='hung-step watchdog: if a training step takes '
+                             'longer than this many seconds (compile-bearing '
+                             'first step exempt), dump all thread stacks and '
+                             'exit with the documented wedge code (75) so a '
+                             'supervisor relaunches with --resume auto. '
+                             '0 disables')
+    parser.add_argument('--max_rollbacks', type=int, default=3,
+                        help='anomaly-recovery budget for --health '
+                             'rollback; exhausting it aborts with exit '
+                             'code 70 (rollback-budget-exhausted)')
+    parser.add_argument('--spike_zscore', type=float, default=8.0,
+                        help='robust z-score (|loss-median| / 1.4826*MAD '
+                             'over a rolling window) above which a finite '
+                             'loss counts as a spike')
     parser.add_argument('--sharded_checkpoints', action='store_true',
                         help='save Orbax sharded checkpoint dirs '
                              '({name}.orbax) with per-host shard IO instead '
@@ -203,6 +230,15 @@ def build_vae(args, distr_backend, resume_vae_params=None):
 
 
 def main(argv=None):
+    """CLI entry: the real run (`_main`) inside the rollback-and-skip
+    escalation loop — a `RollbackAndSkip` escape from the anomaly policy
+    relaunches with `--resume auto`, the offending data window skipped and
+    the LR backed off, bounded by --max_rollbacks (then exit code 70)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return guardrails.run_with_rollback(_main, argv)
+
+
+def _main(argv, lr_scale=1.0, skip_past=None):
     enable_compilation_cache()
     args = parse_args(argv)
 
@@ -291,6 +327,11 @@ def main(argv=None):
     # per vocab phase, each tp-sharded on its own vocab dim, so the phase
     # boundary is a param boundary — no interior-slice resharding)
     pp_mode = args.pipeline_stages > 1
+
+    # training-health guardrails (utils/guardrails.py): health vector on
+    # device, update masked on non-finite grads, host-side anomaly policy
+    health_on = args.health != 'off'
+    health_guard = args.health in ('skip', 'rollback')
 
     tokenizer = select_tokenizer(args.bpe_path, chinese=args.chinese)
     dtype = jnp.bfloat16 if args.fp16 else jnp.float32
@@ -478,7 +519,8 @@ def main(argv=None):
         # slice on its pipeline device (leading-axis 'pp' sharding)
         train_step_pp, params = make_dalle_pp_train_step(
             dalle, tx, params, part.mesh,
-            num_microbatches=args.pipeline_microbatches)
+            num_microbatches=args.pipeline_microbatches,
+            health=health_on, guard=health_guard)
         _stage_shard = NamedSharding(part.mesh, P('pp'))
 
         def _pp_shard(path, leaf):
@@ -619,7 +661,8 @@ def main(argv=None):
         if args.mesh_sp > 1:
             from dalle_pytorch_tpu.training import make_dalle_sp_train_step
 
-            _codes_step = make_dalle_sp_train_step(dalle, tx, part.mesh)
+            _codes_step = make_dalle_sp_train_step(
+                dalle, tx, part.mesh, health=health_on, guard=health_guard)
         else:
             _codes_step = train_step_pp
         if is_custom_vae:
@@ -627,29 +670,37 @@ def main(argv=None):
                 {'params': vp}, imgs,
                 method=DiscreteVAE.get_codebook_indices))
 
-            def train_step(params, opt_state, vae_params, text, images, rng):
+            def train_step(params, opt_state, vae_params, text, images, rng,
+                           *fs):
                 # codes are concrete int32 outputs of a separate jit — no
                 # gradient path into the frozen VAE exists to stop
                 codes = encode_fn(vae_params, images)
-                return _codes_step(params, opt_state, None, text, codes, rng)
+                return _codes_step(params, opt_state, None, text, codes,
+                                   rng, *fs)
         else:
             encode_fn = jax.jit(vae.get_codebook_indices)
 
-            def train_step(params, opt_state, _vae_params, text, images, rng):
+            def train_step(params, opt_state, _vae_params, text, images, rng,
+                           *fs):
                 return _codes_step(params, opt_state, None, text,
-                                   encode_fn(images), rng)
+                                   encode_fn(images), rng, *fs)
     elif is_custom_vae:
         # frozen DiscreteVAE tokenizes images inside the jitted step
-        train_step = make_dalle_train_step(dalle, tx, vae=vae)
+        train_step = make_dalle_train_step(dalle, tx, vae=vae,
+                                           health=health_on,
+                                           guard=health_guard)
     else:
         # pretrained wrapper: encode outside (its params are jit-captured
         # constants), feed codes into a codes-only step
-        _codes_step = make_dalle_train_step(dalle, tx, vae=None)
+        _codes_step = make_dalle_train_step(dalle, tx, vae=None,
+                                            health=health_on,
+                                            guard=health_guard)
         encode_fn = jax.jit(vae.get_codebook_indices)
 
-        def train_step(params, opt_state, _vae_params, text, images, rng):
+        def train_step(params, opt_state, _vae_params, text, images, rng,
+                       *fs):
             codes = encode_fn(images)
-            return _codes_step(params, opt_state, None, text, codes, rng)
+            return _codes_step(params, opt_state, None, text, codes, rng, *fs)
 
     if resume_rng is not None:
         # the checkpointed RNG stream continues bitwise: every subsequent
@@ -663,6 +714,14 @@ def main(argv=None):
     if resume_ckpt is not None and 'scheduler' in resume_ckpt:
         sched.load_state_dict({k: float(v) if isinstance(v, (int, float)) else v
                                for k, v in dict(resume_ckpt['scheduler']).items()})
+    if lr_scale != 1.0:
+        # rollback LR backoff: the restored checkpoint predates the
+        # rollback, so the accumulated scale (0.5 per rollback) applies to
+        # whatever lr the scheduler had at that point
+        sched.lr = max(sched.lr * lr_scale, sched.min_lr)
+        opt_state = set_learning_rate(opt_state, sched.lr)
+        if distr_backend.is_root_worker():
+            print(f'[guardrails] rollback lr backoff: lr={sched.lr:.3e}')
 
     logger = TrainLogger(
         project='dalle_tpu_train_transformer',
@@ -788,6 +847,17 @@ def main(argv=None):
     heartbeat = (Heartbeat(args.heartbeat_dir,
                            stall_timeout=args.stall_timeout or None)
                  if args.heartbeat_dir else None)
+    # anomaly policy over the per-step health vectors + hung-step watchdog
+    monitor_h = (guardrails.HealthMonitor(
+        mode='rollback' if args.health == 'rollback' else
+             ('warn' if args.health == 'warn' else 'skip'),
+        spike_zscore=args.spike_zscore) if health_on else None)
+    watchdog = (guardrails.StepWatchdog(args.step_deadline)
+                if args.step_deadline > 0 else None)
+    if skip_past is not None and distr_backend.is_root_worker():
+        print(f'[guardrails] rollback resume: skipping the data window '
+              f'through step {skip_past} (steps {start_step + 1}..'
+              f'{skip_past} consumed without updates)')
     interrupted = False
     t0 = time.perf_counter()
     completed = False
@@ -812,14 +882,48 @@ def main(argv=None):
                 def flush(pending):
                     if pending is None:
                         return
-                    it, loss_dev = pending
+                    it, sid, loss_dev, hv = pending
                     # average_all here, not at dispatch: the multi-host impl blocks
                     # (process_allgather), which would kill the one-step deferral
                     avg_loss, stop_poll[0] = stopper.average_and_poll(
                         distr_backend, loss_dev)
                     perf = timer.tick(BATCH_SIZE * jax.process_count())
-                    epoch_losses.append(avg_loss)
+                    if monitor_h is None or np.isfinite(avg_loss):
+                        # a sentinel-skipped step left params untouched; its
+                        # NaN must not poison the plateau epoch mean either
+                        epoch_losses.append(avg_loss)
                     logger.step(epoch, it, avg_loss, lr, extra=perf)
+                    if monitor_h is not None:
+                        # every process sees the same avg_loss (collective)
+                        # and the same SPMD health scalars, so the verdict —
+                        # and any rollback escape — is collective too
+                        monitor_h.observe(sid, loss=avg_loss,
+                                          grad_norm=float(hv['grad_norm']),
+                                          applied=float(hv['applied']))
+                        if monitor_h.wants_rollback:
+                            escalate(sid)
+
+                def escalate(sid):
+                    """Anomaly escalation: drop the post-mortem bundle, then
+                    escape to main()'s rollback loop (--resume auto +
+                    data-window skip + LR backoff, budget-bounded)."""
+                    if distr_backend.is_root_worker():
+                        guardrails.write_anomaly_bundle(
+                            args.ckpt_dir, sid, {
+                                'reason': monitor_h.rollback_reason,
+                                'loss': monitor_h.last_loss,
+                                'grad_norm': monitor_h.last_grad_norm,
+                                'loss_history': monitor_h.history(),
+                                'epoch': epoch,
+                                'loader': dl.state_dict(),
+                                'rng': [int(v) for v in
+                                        np.asarray(jax.device_get(rng))],
+                                'config_fingerprint':
+                                    config_fingerprint(dalle_cfg.to_dict()),
+                                'lr': lr})
+                    raise guardrails.RollbackAndSkip(
+                        sid, max_rollbacks=args.max_rollbacks,
+                        reason=monitor_h.rollback_reason or 'anomaly')
 
                 for i, (text, images) in enumerate(dl):
                     # `it` is the TRUE batch index in this epoch's
@@ -829,6 +933,17 @@ def main(argv=None):
                     # where the interrupted run left off — bitwise replay
                     # depends on every rng split landing at the same `it`
                     it = i + (resume_cursor if epoch == start_epoch else 0)
+                    if skip_past is not None and global_step < skip_past:
+                        # rollback-and-skip: consume the anomalous data
+                        # window without training on it; the rng stream
+                        # still advances one split per skipped step so
+                        # post-window draws stay deterministic
+                        rng, _ = jax.random.split(rng)
+                        global_step += 1
+                        if heartbeat is not None:  # skipping is progress
+                            heartbeat.beat(global_step, epoch=epoch,
+                                           health_state='skipping-window')
+                        continue
                     # profiler window: steps 10-20 of the first trained epoch (past
                     # compile + warmup), root process only (ref had no profiler at
                     # all — SURVEY.md §5.1)
@@ -844,13 +959,31 @@ def main(argv=None):
                             jax.profiler.stop_trace()
                             profiling_active = False
                             print(f'profiler trace written to {args.profile_dir}')
+                    if watchdog is not None:
+                        # armed across the whole step iteration (dispatch,
+                        # previous step's host sync, periodic sample/save) —
+                        # any of them can wedge inside a device call
+                        watchdog.arm(global_step + 1)
                     text_b, images_b = part.shard_batch((text.astype(np.int32), images))
                     rng, step_rng = jax.random.split(rng)
-                    params, opt_state, loss = train_step(
-                        params, opt_state, vae_params, text_b, images_b, step_rng)
+                    if health_on:
+                        params, opt_state, loss, health_vec = train_step(
+                            params, opt_state, vae_params, text_b, images_b,
+                            step_rng,
+                            jnp.float32(guardrails.fault_scale_for(
+                                global_step + 1)))
+                    else:
+                        health_vec = None
+                        params, opt_state, loss = train_step(
+                            params, opt_state, vae_params, text_b, images_b,
+                            step_rng)
+                    # chaos rehearsal: GRAFT_FAULTS="step_hang:at_step=N"
+                    # wedges here, inside the watchdog's armed window
+                    faults.maybe_hang(global_step + 1)
 
                     flush(pending)
-                    pending = (it, loss)  # raw device loss; averaged lazily in flush
+                    # raw device loss + health; averaged/classified lazily
+                    pending = (it, global_step + 1, loss, health_vec)
 
                     just_checkpointed = it % 100 == 0
                     if just_checkpointed:
@@ -887,7 +1020,13 @@ def main(argv=None):
                         pending = None
                         save_managed(global_step, epoch)
                     if heartbeat is not None:
-                        heartbeat.beat(global_step, epoch=epoch, loss_iter=it)
+                        # health extras ride every beat so tools/monitor.py
+                        # can flag a sick run without reading logs
+                        heartbeat.beat(global_step, epoch=epoch, loss_iter=it,
+                                       **(monitor_h.beat_extras()
+                                          if monitor_h is not None else {}))
+                    if watchdog is not None:
+                        watchdog.disarm()
                     # chaos rehearsal: GRAFT_FAULTS="sigterm:at_step=N"
                     # delivers a real preemption notice at step N
                     faults.maybe_kill(global_step)
@@ -938,6 +1077,8 @@ def main(argv=None):
 
             completed = not interrupted
     finally:
+        if watchdog is not None:
+            watchdog.close()
         if heartbeat is not None:
             heartbeat.close(done=completed)
 

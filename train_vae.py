@@ -31,7 +31,7 @@ from dalle_pytorch_tpu.cli import host_fetch, enable_compilation_cache
 from dalle_pytorch_tpu.data.dataset import DataLoader, ImageFolderDataset
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
 from dalle_pytorch_tpu.training import make_optimizer, make_vae_train_step, set_learning_rate
-from dalle_pytorch_tpu.utils import faults
+from dalle_pytorch_tpu.utils import faults, guardrails
 from dalle_pytorch_tpu.utils.checkpoint import save_checkpoint
 from dalle_pytorch_tpu.utils.ckpt_manager import (CheckpointManager,
                                                   config_fingerprint)
@@ -61,6 +61,24 @@ def parse_args(argv=None):
                         help='warn on stderr when no step completes for this '
                              'many seconds (0 disables the in-process '
                              'watchdog); requires --heartbeat_dir')
+    parser.add_argument('--health', choices=('off', 'warn', 'skip',
+                                             'rollback'), default='skip',
+                        help='training-health guardrails (see train_dalle '
+                             '--health): on-device health vector per step; '
+                             'skip masks non-finite updates; rollback '
+                             'additionally rolls back to the newest valid '
+                             'managed checkpoint on spikes/divergence')
+    parser.add_argument('--step_deadline', type=float, default=0,
+                        help='hung-step watchdog deadline in seconds '
+                             '(first, compile-bearing step exempt); on '
+                             'expiry dump stacks and exit with the wedge '
+                             'code (75). 0 disables')
+    parser.add_argument('--max_rollbacks', type=int, default=3,
+                        help='anomaly-recovery budget for --health '
+                             'rollback; exhausting it exits 70')
+    parser.add_argument('--spike_zscore', type=float, default=8.0,
+                        help='robust z-score above which a finite loss '
+                             'counts as a spike')
     parser.add_argument('--sharded_checkpoints', action='store_true',
                         help='save Orbax sharded checkpoint dirs '
                              '({name}.orbax) with per-host shard IO instead '
@@ -96,6 +114,13 @@ def parse_args(argv=None):
 
 
 def main(argv=None):
+    """CLI entry: the real run (`_main`) inside the shared rollback-and-
+    skip escalation loop (utils/guardrails.run_with_rollback)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return guardrails.run_with_rollback(_main, argv)
+
+
+def _main(argv, lr_scale=1.0, skip_past=None):
     enable_compilation_cache()
     args = parse_args(argv)
 
@@ -278,7 +303,10 @@ def main(argv=None):
                 opt_state,
                 jax.tree.unflatten(jax.tree.structure(opt_state),
                                    jax.tree.leaves(resume_ckpt['opt_state'])))
-    train_step = make_vae_train_step(vae, tx)
+    health_on = args.health != 'off'
+    train_step = make_vae_train_step(
+        vae, tx, health=health_on,
+        guard=args.health in ('skip', 'rollback'))
 
     sched = ExponentialDecay(LEARNING_RATE, LR_DECAY_RATE)
     temp_sched = GumbelTemperature(STARTING_TEMP, TEMP_MIN, ANNEAL_RATE)
@@ -305,6 +333,13 @@ def main(argv=None):
                                 len(dl))
         else:
             dl.epoch = start_epoch
+    if lr_scale != 1.0:
+        # rollback LR backoff (compounding across relaunches; the restored
+        # checkpoint predates the rollback)
+        sched.lr *= lr_scale
+        opt_state = set_learning_rate(opt_state, sched.lr)
+        if distr_backend.is_root_worker():
+            print(f'[guardrails] rollback lr backoff: lr={sched.lr:.3e}')
 
     logger = TrainLogger(
         project='dalle_tpu_train_vae',
@@ -390,6 +425,48 @@ def main(argv=None):
     heartbeat = (Heartbeat(args.heartbeat_dir,
                            stall_timeout=args.stall_timeout or None)
                  if args.heartbeat_dir else None)
+    # training-health guardrails: anomaly policy + hung-step watchdog
+    monitor_h = (guardrails.HealthMonitor(
+        mode='rollback' if args.health == 'rollback' else
+             ('warn' if args.health == 'warn' else 'skip'),
+        spike_zscore=args.spike_zscore) if health_on else None)
+    watchdog = (guardrails.StepWatchdog(args.step_deadline)
+                if args.step_deadline > 0 else None)
+    if skip_past is not None and distr_backend.is_root_worker():
+        print(f'[guardrails] rollback resume: skipping the data window '
+              f'through step {skip_past}')
+    pending_h = [None]  # (step id, device loss, health vector), 1 deferred
+
+    def observe_health():
+        """Feed the previous step's health vector to the anomaly policy —
+        one step deferred, like the loss logging, so the host sync never
+        stalls the device.  The loss is an output of the one SPMD step
+        program, identical on every process, so verdicts are collective."""
+        if monitor_h is None or pending_h[0] is None:
+            return
+        sid, loss_dev, hv = pending_h[0]
+        pending_h[0] = None
+        monitor_h.observe(sid, loss=float(loss_dev),
+                          grad_norm=float(hv['grad_norm']),
+                          applied=float(hv['applied']))
+        if monitor_h.wants_rollback:
+            if distr_backend.is_root_worker():
+                guardrails.write_anomaly_bundle(
+                    args.ckpt_dir, sid, {
+                        'reason': monitor_h.rollback_reason,
+                        'loss': monitor_h.last_loss,
+                        'grad_norm': monitor_h.last_grad_norm,
+                        'loss_history': monitor_h.history(),
+                        'loader': dl.state_dict(),
+                        'rng': [int(v) for v in
+                                np.asarray(jax.device_get(rng))],
+                        'config_fingerprint':
+                            config_fingerprint(cfg.to_dict()),
+                        'lr': lr})
+            raise guardrails.RollbackAndSkip(
+                sid, max_rollbacks=args.max_rollbacks,
+                reason=monitor_h.rollback_reason or 'anomaly')
+
     t_step = time.perf_counter()
     try:
         with stopper:
@@ -400,11 +477,36 @@ def main(argv=None):
                     # cadences below must continue from the interrupted
                     # position, not restart at 0
                     it = i + (resume_cursor if epoch == start_epoch else 0)
+                    if skip_past is not None and global_step < skip_past:
+                        # rollback-and-skip: consume the anomalous data
+                        # window without training on it
+                        rng, _ = jax.random.split(rng)
+                        global_step += 1
+                        if heartbeat is not None:
+                            heartbeat.beat(global_step, epoch=epoch,
+                                           health_state='skipping-window')
+                        continue
+                    if watchdog is not None:
+                        watchdog.arm(global_step + 1)
                     batch = part.shard_batch(images)
                     rng, step_rng = jax.random.split(rng)
-                    params, opt_state, loss, recons = train_step(
-                        params, opt_state, batch, step_rng,
-                        jnp.asarray(temp, jnp.float32))
+                    if health_on:
+                        params, opt_state, loss, recons, health_vec = \
+                            train_step(params, opt_state, batch, step_rng,
+                                       jnp.asarray(temp, jnp.float32),
+                                       jnp.float32(guardrails.fault_scale_for(
+                                           global_step + 1)))
+                    else:
+                        health_vec = None
+                        params, opt_state, loss, recons = train_step(
+                            params, opt_state, batch, step_rng,
+                            jnp.asarray(temp, jnp.float32))
+                    # chaos rehearsal: GRAFT_FAULTS="step_hang:at_step=N"
+                    # wedges here, inside the watchdog's armed window
+                    faults.maybe_hang(global_step + 1)
+                    observe_health()  # previous step's verdict (deferred)
+                    if health_on:
+                        pending_h[0] = (global_step + 1, loss, health_vec)
 
                     if it % 100 == 0:
                         # periodic probes (ref :187-209): SPMD computations run
@@ -451,9 +553,19 @@ def main(argv=None):
                                     extra={'temperature': temp, 'sec_per_10steps': dt})
                     global_step += 1
                     if args.ckpt_every > 0 and it % args.ckpt_every == 0:
+                        # observe THIS step's health before it reaches a
+                        # manifest: an anomaly must escalate here so the
+                        # rollback target is the previous (pre-anomaly)
+                        # checkpoint, never this one (train_dalle orders
+                        # its flush before save_managed the same way)
+                        observe_health()
                         save_vae_managed(global_step, epoch)
                     if heartbeat is not None:
-                        heartbeat.beat(global_step, epoch=epoch)
+                        heartbeat.beat(global_step, epoch=epoch,
+                                       **(monitor_h.beat_extras()
+                                          if monitor_h is not None else {}))
+                    if watchdog is not None:
+                        watchdog.disarm()
                     # chaos rehearsal: GRAFT_FAULTS="sigterm:at_step=N"
                     faults.maybe_kill(global_step)
                     # multi-process: the collective decision from the last
@@ -478,6 +590,8 @@ def main(argv=None):
                     break
             completed = not interrupted
     finally:
+        if watchdog is not None:
+            watchdog.close()
         if heartbeat is not None:
             heartbeat.close(done=completed)
 
