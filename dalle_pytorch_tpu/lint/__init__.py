@@ -18,13 +18,17 @@ justification**::
 A pragma without a parenthesized reason is itself an error (PRAGMA001) —
 suppressions document *why* the rule does not apply, or they don't count.
 """
-from .engine import (Finding, filter_baseline, fingerprint, fix_env001,
-                     iter_python_files, lint_paths, lint_source,
-                     load_baseline, write_baseline)
+from .engine import (FINDINGS_JSON_SCHEMA, Finding, filter_baseline,
+                     findings_to_json, findings_to_sarif, fingerprint,
+                     fix_env001, iter_python_files, lint_paths, lint_source,
+                     load_baseline, prune_baseline, stale_baseline_entries,
+                     write_baseline)
 from .rules import RULES
 
 __all__ = [
     "Finding", "RULES", "lint_source", "lint_paths", "fingerprint",
     "iter_python_files",
     "load_baseline", "write_baseline", "filter_baseline", "fix_env001",
+    "stale_baseline_entries", "prune_baseline",
+    "findings_to_json", "findings_to_sarif", "FINDINGS_JSON_SCHEMA",
 ]

@@ -49,17 +49,21 @@ def fingerprint(f: Finding) -> str:
     return f"{f.path}::{f.rule}::{crc:08x}"
 
 
-def _parse_pragmas(src: str) -> Tuple[Dict[int, Set[str]], List[Finding]]:
-    """Map line -> set of disabled rules, plus PRAGMA001 findings for
-    pragmas missing the mandatory justification."""
+def _parse_pragmas(src: str) -> Tuple[Dict[int, Set[str]], List[Finding],
+                                      List[Tuple[int, Set[str], str]]]:
+    """Map line -> set of disabled rules, PRAGMA001 findings for pragmas
+    missing the mandatory justification, and the justified pragma entries
+    ``(line, rules, comment)`` themselves (for unused-suppression
+    accounting)."""
     disabled: Dict[int, Set[str]] = {}
     errors: List[Finding] = []
+    pragmas: List[Tuple[int, Set[str], str]] = []
     try:
         tokens = tokenize.generate_tokens(io.StringIO(src).readline)
         comments = [(t.start[0], t.string) for t in tokens
                     if t.type == tokenize.COMMENT]
     except (tokenize.TokenError, IndentationError):
-        return disabled, errors
+        return disabled, errors, pragmas
     for line, comment in comments:
         m = _PRAGMA_RE.search(comment)
         if not m:
@@ -75,22 +79,27 @@ def _parse_pragmas(src: str) -> Tuple[Dict[int, Set[str]], List[Finding]]:
                 line_text=comment, end_line=line))
             continue
         disabled.setdefault(line, set()).update(rules)
-    return disabled, errors
+        pragmas.append((line, rules, comment))
+    return disabled, errors, pragmas
 
 
-def _suppressed(f: Finding, disabled: Dict[int, Set[str]]) -> bool:
-    """A pragma suppresses a finding from the line above it, any line of
-    the flagged statement, or the statement's first line."""
+def _suppressing_lines(f: Finding, disabled: Dict[int, Set[str]]) -> List[int]:
+    """The pragma lines that suppress this finding: the line above the
+    flagged statement, any line of the statement, or its first line."""
     lines = range(f.line - 1, max(f.end_line, f.line) + 1)
-    return any(f.rule in disabled.get(ln, ()) or "ALL" in disabled.get(ln, ())
-               for ln in lines)
+    return [ln for ln in lines
+            if f.rule in disabled.get(ln, ()) or "ALL" in disabled.get(ln, ())]
 
 
 def lint_source(src: str, path: str = "<string>",
                 select: Optional[Iterable[str]] = None) -> List[Finding]:
     """Run the (selected) rules over one source string.  Returns findings
-    with pragma suppression already applied; unsuppressable PRAGMA001
-    findings (justification-less pragmas) are included."""
+    with pragma suppression already applied; unsuppressable engine
+    findings are included: PRAGMA001 (justification-less pragmas) and
+    PRAGMA002 (justified pragmas that suppress nothing — stale
+    suppressions outlive refactors and silently blind the rule they once
+    excused; PRAGMA002 is only judged when every rule the pragma names was
+    actually run, so ``--select`` subsets don't misreport)."""
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
@@ -100,11 +109,12 @@ def lint_source(src: str, path: str = "<string>",
                         line_text="", end_line=e.lineno or 1)]
     lines = src.splitlines()
     ctx = FileCtx(path=path, tree=tree, lines=lines)
-    disabled, pragma_errors = _parse_pragmas(src)
+    disabled, pragma_errors, pragmas = _parse_pragmas(src)
 
     findings: List[Finding] = []
-    rules = RULES if select is None else {
-        k: v for k, v in RULES.items() if k in set(select)}
+    selected = None if select is None else {r.upper() for r in select}
+    rules = RULES if selected is None else {
+        k: v for k, v in RULES.items() if k in selected}
     for rule_name, rule_fn in rules.items():
         for node, message in rule_fn(ctx):
             line = getattr(node, "lineno", 1)
@@ -114,9 +124,29 @@ def lint_source(src: str, path: str = "<string>",
                 col=getattr(node, "col_offset", 0), message=message,
                 line_text=text,
                 end_line=getattr(node, "end_lineno", line) or line))
-    findings = [f for f in findings if not _suppressed(f, disabled)]
-    findings.extend(dataclasses.replace(e, path=path) for e in pragma_errors)
-    return sorted(findings, key=lambda f: (f.line, f.col, f.rule))
+    kept: List[Finding] = []
+    used_pragma_lines: Set[int] = set()
+    for f in findings:
+        hit = _suppressing_lines(f, disabled)
+        if hit:
+            used_pragma_lines.update(hit)
+        else:
+            kept.append(f)
+    for line, prules, comment in pragmas:
+        if line in used_pragma_lines:
+            continue
+        judgeable = selected is None or (
+            "ALL" not in prules and prules <= selected)
+        if not judgeable:
+            continue
+        kept.append(Finding(
+            path=path, rule="PRAGMA002", line=line, col=0,
+            message=f"unused suppression: this pragma disables "
+                    f"{','.join(sorted(prules))} but suppresses no finding "
+                    "— the code it excused is gone; delete the pragma",
+            line_text=comment, end_line=line))
+    kept.extend(dataclasses.replace(e, path=path) for e in pragma_errors)
+    return sorted(kept, key=lambda f: (f.line, f.col, f.rule))
 
 
 _SKIP_DIRS = {"__pycache__", ".git", ".cache", "node_modules", ".venv"}
@@ -166,6 +196,118 @@ def write_baseline(findings: Sequence[Finding], path) -> None:
 def filter_baseline(findings: Sequence[Finding],
                     baseline: Set[str]) -> List[Finding]:
     return [f for f in findings if fingerprint(f) not in baseline]
+
+
+def stale_baseline_entries(findings: Sequence[Finding],
+                           baseline: Set[str]) -> List[str]:
+    """Baseline fingerprints matching no current finding — each one is a
+    fixed (or vanished) legacy finding whose grandfather entry now only
+    risks masking a future regression at the same source line.  Pass the
+    PRE-filter findings; prune with ``tools/graftlint.py
+    --prune-baseline``."""
+    live = {fingerprint(f) for f in findings}
+    return sorted(baseline - live)
+
+
+def prune_baseline(findings: Sequence[Finding], path) -> List[str]:
+    """Rewrite the baseline at ``path`` keeping only fingerprints that
+    still match a (pre-filter) finding; returns the dropped stale
+    entries.  No-op when the file does not exist."""
+    baseline = load_baseline(path)
+    if not baseline:
+        return []
+    stale = stale_baseline_entries(findings, baseline)
+    if stale:
+        live = {fingerprint(f) for f in findings}
+        Path(path).write_text(json.dumps(
+            {"comment": "graftlint baseline — known findings grandfathered "
+                        "in; regenerate with tools/graftlint.py "
+                        "--write-baseline",
+             "suppressed": sorted(baseline & live)}, indent=2) + "\n")
+    return stale
+
+
+# --- machine-readable output ---------------------------------------------
+
+# The contract CI consumes (tests/test_graftlint.py validates emitted
+# documents against this schema): bump "version" on breaking changes.
+FINDINGS_JSON_SCHEMA = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "type": "object",
+    "required": ["tool", "version", "files_scanned", "counts", "findings"],
+    "additionalProperties": False,
+    "properties": {
+        "tool": {"const": "graftlint"},
+        "version": {"type": "integer", "minimum": 1},
+        "files_scanned": {"type": "integer", "minimum": 0},
+        "counts": {"type": "object",
+                   "additionalProperties": {"type": "integer"}},
+        "findings": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["path", "rule", "line", "col", "message",
+                             "fingerprint"],
+                "additionalProperties": False,
+                "properties": {
+                    "path": {"type": "string"},
+                    "rule": {"type": "string", "pattern": "^[A-Z0-9_]+$"},
+                    "line": {"type": "integer", "minimum": 1},
+                    "col": {"type": "integer", "minimum": 0},
+                    "message": {"type": "string"},
+                    "fingerprint": {"type": "string"},
+                },
+            },
+        },
+    },
+}
+
+
+def findings_to_json(findings: Sequence[Finding],
+                     files_scanned: int = 0) -> dict:
+    """Findings as the JSON document FINDINGS_JSON_SCHEMA describes."""
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "tool": "graftlint",
+        "version": 1,
+        "files_scanned": files_scanned,
+        "counts": counts,
+        "findings": [
+            {"path": f.path, "rule": f.rule, "line": f.line, "col": f.col,
+             "message": f.message, "fingerprint": fingerprint(f)}
+            for f in findings],
+    }
+
+
+def findings_to_sarif(findings: Sequence[Finding]) -> dict:
+    """Findings as a minimal SARIF 2.1.0 log (the format code-scanning
+    UIs ingest); fingerprints carry the baseline identity."""
+    from .rules import RULES
+
+    rule_ids = sorted({f.rule for f in findings} | set(RULES))
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "rules": [{"id": r} for r in rule_ids],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                }}],
+                "partialFingerprints": {"graftlint/v1": fingerprint(f)},
+            } for f in findings],
+        }],
+    }
 
 
 # --- ENV001 mechanical fix ----------------------------------------------

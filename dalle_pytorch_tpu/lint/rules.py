@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-from typing import Iterator, List, Tuple
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
 
 RuleHit = Tuple[ast.AST, str]
 
@@ -350,6 +351,182 @@ def rule_ckpt001(ctx: FileCtx) -> Iterator[RuleHit]:
             yield node, msg.format(label)
 
 
+# --- DON001/DON002: buffer donation (the AST side of graftspmd S2) --------
+
+_STEP_FACTORY_RE = re.compile(r"^make_\w*step\w*$")
+_TRAIN_STEP_FACTORY_RE = re.compile(r"^make_\w*train_step$")
+
+
+def _jit_call_keywords(call: ast.Call) -> Optional[List[ast.keyword]]:
+    """The keyword list of a jit/pjit wrapping call (including the
+    ``partial(jax.jit, ...)`` form), or None if ``call`` is not one."""
+    chain = _attr_chain(call.func)
+    if chain.endswith("partial") and call.args \
+            and _attr_chain(call.args[0]).split(".")[-1] in ("jit", "pjit"):
+        return list(call.keywords)
+    if chain.split(".")[-1] in ("jit", "pjit"):
+        return list(call.keywords)
+    return None
+
+
+def rule_don001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """A train-step factory that jits without ``donate_argnums`` ships a
+    step holding params+opt_state alive TWICE across the update (inputs
+    kept by the caller, outputs fresh buffers) — at CUB geometry that is
+    ~350 MiB of silent HBM overhead per chip, and the optimizer-state
+    double is exactly how plans that "should fit" OOM.  Every jit inside
+    a ``make_*step*`` factory must state its donation (an explicit empty
+    ``donate_argnums=()`` is a statement, and the dynamic half — whether
+    the donation survives compilation — is graftspmd S2's job)."""
+    msg = ("jit inside step factory {!r} without donate_argnums: the "
+           "returned step keeps params/opt_state buffers alive twice "
+           "across the update; state the donation explicitly "
+           "(donate_argnums=(0, 1), or =() with a pragma-level reason)")
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or not _STEP_FACTORY_RE.match(fn.name):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            kws = _jit_call_keywords(node)
+            if kws is None:
+                continue
+            if not any(kw.arg in ("donate_argnums", "donate_argnames")
+                       for kw in kws):
+                yield node, msg.format(fn.name)
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Positional indices a call's assignee will donate, if statically
+    knowable: ``jax.jit(..., donate_argnums=<literal>)`` or a
+    ``make_*_train_step(...)`` factory call (donates (0, 1) unless built
+    with ``donate=False`` or ``jit=False``)."""
+    kws = {kw.arg: kw.value for kw in call.keywords}
+    jit_kws = _jit_call_keywords(call)
+    if jit_kws is not None:
+        da = kws.get("donate_argnums")
+        if isinstance(da, ast.Constant) and isinstance(da.value, int):
+            return (da.value,)
+        if isinstance(da, ast.Tuple) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in da.elts):
+            return tuple(e.value for e in da.elts)
+        return None
+    if isinstance(call.func, ast.Name) \
+            and _TRAIN_STEP_FACTORY_RE.match(call.func.id):
+        for off in ("donate", "jit"):
+            v = kws.get(off)
+            if isinstance(v, ast.Constant) and v.value is False:
+                return None
+        return (0, 1)
+    return None
+
+
+def _target_names(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in node.elts:
+            out.extend(_target_names(e.value if isinstance(e, ast.Starred)
+                                     else e))
+        return out
+    return []
+
+
+def rule_don002(ctx: FileCtx) -> Iterator[RuleHit]:
+    """A variable passed at a donated position is DEAD after the call —
+    jax invalidates the buffer — yet a read after the call is only caught
+    at runtime ("array has been deleted"), typically on the untested
+    resume/periodic-save path.  Flags donated args that are read again
+    later in the same scope without the call statement rebinding them
+    (the ``params, opt_state, ... = step(params, opt_state, ...)`` idiom
+    is the clean shape).  Tracks single-name assignments from
+    ``jax.jit(..., donate_argnums=...)`` and ``make_*_train_step(...)``
+    calls; syntactic over-approximation — a read on a disjoint branch
+    needs a pragma with the reason."""
+    msg = ("{!r} is donated by this call (position {}) and its buffer is "
+           "deleted, but it is read again at line {} in the same scope; "
+           "rebind it from the call's outputs or drop the later read")
+    scopes = [ctx.tree] + [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for scope in scopes:
+        body = scope.body if hasattr(scope, "body") else []
+        wrapped = ast.Module(body=body, type_ignores=[])
+        # per-scope tracking: a name is donating only while its latest
+        # single-name assignment in THIS scope is a donating jit/factory
+        # call (a donate=False or unrelated reassignment drops it)
+        donating: Dict[str, Tuple[int, ...]] = {}
+        for node in _walk_skip_defs(wrapped):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                pos = _donated_positions(node.value) \
+                    if isinstance(node.value, ast.Call) else None
+                if pos:
+                    donating[node.targets[0].id] = pos
+                else:
+                    donating.pop(node.targets[0].id, None)
+        if not donating:
+            continue
+        loads = [(n.lineno, n.id) for n in _walk_skip_defs(wrapped)
+                 if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)]
+        for stmt in body:
+            yield from _don002_stmt(stmt, donating, loads, msg)
+
+
+_STMT_CONTAINERS = (ast.ExceptHandler,) + (
+    (ast.match_case,) if hasattr(ast, "match_case") else ())
+
+
+def _own_exprs(stmt: ast.AST) -> Iterator[ast.AST]:
+    """The expressions belonging to this statement itself — its header and
+    inline values, but not its sub-statements (each gets its own
+    rebinding context) and not nested def/lambda bodies (their params
+    shadow outer names)."""
+    skip = (ast.stmt, ast.Lambda) + _STMT_CONTAINERS
+    stack = [c for c in ast.iter_child_nodes(stmt)
+             if not isinstance(c, skip)]
+    while stack:
+        n = stack.pop()
+        yield n
+        stack.extend(c for c in ast.iter_child_nodes(n)
+                     if not isinstance(c, skip))
+
+
+def _don002_stmt(stmt: ast.AST, donating, loads, msg) -> Iterator[RuleHit]:
+    """Check one statement's own expressions for tracked donating calls,
+    recursing into compound-statement bodies (each inner statement carries
+    its own rebinding context) but not nested defs."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, (ast.stmt,) + _STMT_CONTAINERS):
+            yield from _don002_stmt(child, donating, loads, msg)
+    rebound = [n for t in stmt.targets for n in _target_names(t)] \
+        if isinstance(stmt, ast.Assign) else []
+    end = stmt.end_lineno or stmt.lineno
+    for node in _own_exprs(stmt):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Name):
+            continue
+        positions = donating.get(node.func.id)
+        if not positions:
+            continue
+        for pos in positions:
+            if pos >= len(node.args) or not isinstance(node.args[pos],
+                                                       ast.Name):
+                continue
+            name = node.args[pos].id
+            if name in rebound:
+                continue
+            later = [ln for ln, nid in loads if nid == name and ln > end]
+            if later:
+                yield node, msg.format(name, pos, min(later))
+
+
 RULES = {
     "ENV001": rule_env001,
     "SEED001": rule_seed001,
@@ -358,4 +535,6 @@ RULES = {
     "TRACE001": rule_trace001,
     "EXC001": rule_exc001,
     "CKPT001": rule_ckpt001,
+    "DON001": rule_don001,
+    "DON002": rule_don002,
 }

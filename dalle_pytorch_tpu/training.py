@@ -53,14 +53,33 @@ def set_learning_rate(opt_state, lr: float):
     return opt_state
 
 
+def _pin_update_shardings(partitioner, params, opt_state):
+    """Constrain the updated params/opt_state to the Partitioner's input
+    sharding rules.  Without this, GSPMD output-sharding propagation is
+    free to place some updated leaves differently from their inputs — and
+    jax silently DROPS buffer donation for exactly those leaves (graftspmd
+    S2 caught ~2/3 of the donated leaves losing their aliases under the tp
+    plan), so those params/opt_state buffers live twice across the
+    update."""
+    if partitioner is None:
+        return params, opt_state
+    params = jax.lax.with_sharding_constraint(
+        params, partitioner.param_shardings(params))
+    opt_state = jax.lax.with_sharding_constraint(
+        opt_state, partitioner.param_shardings(opt_state))
+    return params, opt_state
+
+
 def make_vae_train_step(vae, tx, donate: bool = True, health: bool = False,
-                        guard: bool = True):
+                        guard: bool = True, partitioner=None):
     """(params, opt_state, images, rng, temp) -> (params, opt_state, loss, recons).
 
     `temp` is a traced scalar so the gumbel temperature anneal
     (train_vae.py:211-217) never retraces.  With ``health=True`` the step
     takes a trailing ``fault_scale`` scalar and additionally returns the
-    on-device health vector (module docstring).
+    on-device health vector (module docstring).  ``partitioner`` (the
+    run's mesh Partitioner) pins the updated params/opt_state to the
+    input sharding rules so donation survives GSPMD propagation.
     """
 
     def train_step(params, opt_state, images, rng, temp, *fault_scale):
@@ -76,9 +95,13 @@ def make_vae_train_step(vae, tx, donate: bool = True, health: bool = False,
         if health:
             params, opt_state, hv = guardrails.guarded_update(
                 tx, grads, opt_state, params, loss=loss, guard=guard)
+            params, opt_state = _pin_update_shardings(partitioner, params,
+                                                      opt_state)
             return params, opt_state, loss, recons, hv
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        params, opt_state = _pin_update_shardings(partitioner, params,
+                                                  opt_state)
         return params, opt_state, loss, recons
 
     return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
@@ -100,7 +123,7 @@ def _dalle_loss(dalle, params, text, codes, rng):
 
 def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
                           jit: bool = True, health: bool = False,
-                          guard: bool = True):
+                          guard: bool = True, partitioner=None):
     """DALLE step.  If `vae` is given, batches carry raw images and the
     (frozen) VAE encodes them to codes inside the step, mirroring the
     reference's in-forward `vae.get_codebook_indices` under no_grad
@@ -110,6 +133,9 @@ def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
     program, e.g. a scan-of-steps benchmark loop).  With ``health=True``
     the step takes a trailing ``fault_scale`` scalar and additionally
     returns the on-device health vector (module docstring).
+    ``partitioner`` (the run's mesh Partitioner) pins the updated
+    params/opt_state to the input sharding rules so donation survives
+    GSPMD propagation.
     """
 
     def train_step(params, opt_state, vae_params, text, images_or_codes,
@@ -129,9 +155,13 @@ def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
         if health:
             params, opt_state, hv = guardrails.guarded_update(
                 tx, grads, opt_state, params, loss=loss, guard=guard)
+            params, opt_state = _pin_update_shardings(partitioner, params,
+                                                      opt_state)
             return params, opt_state, loss, hv
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        params, opt_state = _pin_update_shardings(partitioner, params,
+                                                  opt_state)
         return params, opt_state, loss
 
     if not jit:
@@ -279,7 +309,10 @@ def pp_params_to_dense(dalle, pp_params, mesh, pp_axis: str = "pp"):
 
 
 def make_clip_train_step(clip, tx, donate: bool = True, health: bool = False,
-                         guard: bool = True):
+                         guard: bool = True, partitioner=None):
+    """CLIP contrastive step (text/image towers, symmetric CE).
+    ``partitioner`` pins the updated params/opt_state to the input
+    sharding rules so donation survives GSPMD propagation."""
     def train_step(params, opt_state, text, images, text_mask, *fault_scale):
         def loss_fn(p):
             loss = clip.apply({"params": p}, text, images,
@@ -290,9 +323,27 @@ def make_clip_train_step(clip, tx, donate: bool = True, health: bool = False,
         if health:
             params, opt_state, hv = guardrails.guarded_update(
                 tx, grads, opt_state, params, loss=loss, guard=guard)
+            params, opt_state = _pin_update_shardings(partitioner, params,
+                                                      opt_state)
             return params, opt_state, loss, hv
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        params, opt_state = _pin_update_shardings(partitioner, params,
+                                                  opt_state)
         return params, opt_state, loss
 
     return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+
+
+# Every train-step factory in this module, by name.  tools/spmd_check.py
+# (the graftspmd analyzer) traces each entry under every applicable
+# parallelism plan — collective order, donation audit, retrace sentinel,
+# static HBM budget — and asserts its harness coverage matches THIS
+# registry exactly, so a new factory cannot land unanalyzed.
+STEP_FACTORIES = {
+    "vae": make_vae_train_step,
+    "dalle": make_dalle_train_step,
+    "dalle_sp": make_dalle_sp_train_step,
+    "dalle_pp": make_dalle_pp_train_step,
+    "clip": make_clip_train_step,
+}

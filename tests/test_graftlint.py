@@ -13,9 +13,12 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from dalle_pytorch_tpu.lint import (RULES, Finding, filter_baseline,  # noqa: E402
+from dalle_pytorch_tpu.lint import (FINDINGS_JSON_SCHEMA, RULES,  # noqa: E402
+                                    Finding, filter_baseline,
+                                    findings_to_json, findings_to_sarif,
                                     fingerprint, fix_env001, lint_paths,
                                     lint_source, load_baseline,
+                                    prune_baseline, stale_baseline_entries,
                                     write_baseline)
 
 
@@ -310,6 +313,256 @@ def test_ckpt001_pragma_with_reason_suppresses():
 # --- engine machinery ----------------------------------------------------
 
 
+# --- DON001 --------------------------------------------------------------
+
+
+def test_don001_jit_without_donation_in_factory_flagged():
+    src = """
+    import jax
+
+    def make_toy_train_step(model, tx):
+        def train_step(params, opt_state, batch):
+            return params, opt_state
+        return jax.jit(train_step)
+    """
+    assert rules_of(lint(src, select=("DON001",))) == ["DON001"]
+
+
+def test_don001_stated_donation_clean():
+    src = """
+    import jax
+    from functools import partial
+
+    def make_toy_train_step(model, tx):
+        def train_step(params, opt_state, batch):
+            return params, opt_state
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    def make_eval_step(model):
+        # an explicit empty donation is a statement, not an omission
+        return jax.jit(lambda p, b: p, donate_argnums=())
+
+    def make_named_train_step(model):
+        @partial(jax.jit, donate_argnames=("params",))
+        def train_step(params, batch):
+            return params
+        return train_step
+    """
+    assert lint(src, select=("DON001",)) == []
+
+
+def test_don001_jit_outside_factory_clean():
+    src = """
+    import jax
+    encode_fn = jax.jit(encode)
+
+    def not_a_factory():
+        return jax.jit(lambda x: x)
+    """
+    assert lint(src, select=("DON001",)) == []
+
+
+def test_don001_pragma():
+    src = """
+    import jax
+
+    def make_probe_step():
+        # graftlint: disable=DON001 (stateless probe: nothing to donate)
+        return jax.jit(lambda x: x * 2)
+    """
+    assert lint(src, select=("DON001",)) == []
+
+
+# --- DON002 --------------------------------------------------------------
+
+
+def test_don002_donated_arg_read_after_call_flagged():
+    src = """
+    import jax
+
+    def run(params, opt_state, batches):
+        step = jax.jit(train_step, donate_argnums=(0, 1))
+        for batch in batches:
+            new_params, new_opt, loss = step(params, opt_state, batch)
+        return params  # deleted buffer: runtime error on the pod
+    """
+    found = lint(src, select=("DON002",))
+    assert rules_of(found) == ["DON002"]
+    assert "'params'" in found[0].message
+
+
+def test_don002_rebinding_idiom_clean():
+    src = """
+    import jax
+
+    def run(params, opt_state, batches):
+        step = jax.jit(train_step, donate_argnums=(0, 1))
+        for batch in batches:
+            params, opt_state, loss = step(params, opt_state, batch)
+        return params
+    """
+    assert lint(src, select=("DON002",)) == []
+
+
+def test_don002_factory_call_tracked_and_donate_false_exempt():
+    src = """
+    def run(params, opt_state, batches):
+        step = make_toy_train_step(model, tx)
+        params2, opt2, loss = step(params, opt_state, batches[0])
+        save(params)
+
+    def run_undonating(params, opt_state, batches):
+        step = make_toy_train_step(model, tx, donate=False)
+        params2, opt2, loss = step(params, opt_state, batches[0])
+        save(params)
+    """
+    found = lint(src, select=("DON002",))
+    assert rules_of(found) == ["DON002"]
+    assert found[0].line < 7  # only the donating factory's call site
+
+
+def test_don002_nested_def_params_shadow_outer_names():
+    """Regression: a nested wrapper whose parameters shadow the outer
+    names must not attribute its inner step call to the outer scope
+    (the train_dalle.py frozen-VAE wrapper shape)."""
+    src = """
+    def run(params, opt_state, use_wrapper):
+        _codes_step = make_toy_train_step(model, tx)
+        if use_wrapper:
+            def train_step(params, opt_state, batch):
+                return _codes_step(params, opt_state, batch)
+        else:
+            train_step = _codes_step
+        for batch in batches:
+            params, opt_state, loss = train_step(params, opt_state, batch)
+        save(params)
+    """
+    assert lint(src, select=("DON002",)) == []
+
+
+def test_don002_pragma():
+    src = """
+    import jax
+
+    def run(params, opt_state, batch):
+        step = jax.jit(train_step, donate_argnums=(0,))
+        # graftlint: disable=DON002 (step aborts before the read on this branch)
+        new_params, loss = step(params, opt_state, batch)
+        return params
+    """
+    assert lint(src, select=("DON002",)) == []
+
+
+# --- PRAGMA002: unused suppressions --------------------------------------
+
+
+def test_pragma002_unused_suppression_flagged():
+    src = """
+    x = 1  # graftlint: disable=ENV001 (legacy reason, code since rewritten)
+    """
+    found = lint(src, select=("ENV001",))
+    assert rules_of(found) == ["PRAGMA002"]
+
+
+def test_pragma002_used_suppression_clean():
+    src = """
+    import os
+    if os.environ.get("X"):  # graftlint: disable=ENV001 (value-valued var)
+        pass
+    """
+    assert lint(src, select=("ENV001",)) == []
+
+
+def test_pragma002_not_judged_when_rule_not_run():
+    # an ENV001 pragma cannot be called unused when ENV001 wasn't run
+    src = """
+    x = 1  # graftlint: disable=ENV001 (reason)
+    """
+    assert lint(src, select=("SEED001",)) == []
+
+
+def test_pragma002_multi_rule_pragma_judged_only_fully_selected():
+    src = """
+    import os
+    if os.environ.get("X"):  # graftlint: disable=ENV001,SEED001 (reason)
+        pass
+    """
+    # full run: ENV001 fires and is suppressed -> pragma is used
+    assert lint(src) == []
+    # SEED001-only run: the pragma names a rule that wasn't run -> skip
+    assert lint(src, select=("SEED001",)) == []
+
+
+# --- machine-readable output ---------------------------------------------
+
+
+def test_findings_json_validates_against_schema():
+    import jsonschema
+
+    src = 'import os\nif os.environ.get("A"):\n    pass\n'
+    findings = lint_source(src, path="x.py")
+    doc = findings_to_json(findings, files_scanned=1)
+    jsonschema.validate(doc, FINDINGS_JSON_SCHEMA)
+    assert doc["counts"] == {"ENV001": 1}
+    assert doc["findings"][0]["fingerprint"] == fingerprint(findings[0])
+    # empty documents validate too (the clean-tree CI artifact)
+    jsonschema.validate(findings_to_json([], files_scanned=0),
+                        FINDINGS_JSON_SCHEMA)
+
+
+def test_findings_sarif_minimal_shape():
+    src = 'import os\nif os.environ.get("A"):\n    pass\n'
+    doc = findings_to_sarif(lint_source(src, path="x.py"))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    (res,) = run["results"]
+    assert res["ruleId"] == "ENV001"
+    assert res["locations"][0]["physicalLocation"]["artifactLocation"][
+        "uri"] == "x.py"
+    assert res["partialFingerprints"]["graftlint/v1"].startswith("x.py::")
+
+
+def test_cli_format_json_and_output(tmp_path):
+    import jsonschema
+
+    spec = importlib.util.spec_from_file_location(
+        "graftlint_cli3", REPO / "tools" / "graftlint.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text('import os\nif os.environ.get("A"):\n    pass\n')
+    out = tmp_path / "lint.json"
+    rc = mod.main([str(dirty), "--baseline", str(tmp_path / "no-bl.json"),
+                   "--format", "json", "--output", str(out)])
+    assert rc == 1  # findings still fail the run in machine formats
+    doc = json.loads(out.read_text())
+    jsonschema.validate(doc, FINDINGS_JSON_SCHEMA)
+    assert doc["counts"] == {"ENV001": 1}
+
+
+# --- stale-baseline accounting -------------------------------------------
+
+
+def test_stale_baseline_entries_and_prune(tmp_path):
+    dirty = tmp_path / "legacy.py"
+    dirty.write_text('import os\nif os.environ.get("A"):\n    pass\n')
+    bl = tmp_path / "bl.json"
+    findings = lint_paths([str(dirty)])
+    write_baseline(findings, bl)
+    # finding fixed -> its fingerprint is stale
+    dirty.write_text("x = 1\n")
+    now = lint_paths([str(dirty)])
+    stale = stale_baseline_entries(now, load_baseline(bl))
+    assert len(stale) == 1 and "ENV001" in stale[0]
+    dropped = prune_baseline(now, bl)
+    assert dropped == stale
+    assert load_baseline(bl) == set()
+    # pruning an already-clean baseline is a no-op
+    assert prune_baseline(now, bl) == []
+    assert prune_baseline(now, tmp_path / "missing.json") == []
+
+
 def test_syntax_error_reported_not_crashed():
     found = lint_source("def broken(:\n    pass\n", path="x.py")
     assert rules_of(found) == ["PARSE001"]
@@ -421,7 +674,7 @@ def test_every_rule_has_fixture_coverage():
     """Meta: the rule registry and this file stay in sync — adding a rule
     without positive-fixture coverage fails here."""
     covered = {"ENV001", "SEED001", "BACKEND001", "DOT001", "TRACE001",
-               "EXC001", "CKPT001"}
+               "EXC001", "CKPT001", "DON001", "DON002"}
     assert covered == set(RULES)
 
 

@@ -126,6 +126,22 @@ if ! env JAX_PLATFORMS=cpu timeout 600 python tools/contract_check.py \
 fi
 echo "$(date +%T) contract check PASS"
 
+# -- second chip-free gate: graftspmd (jaxpr-level SPMD analyses) ----------
+# spmd_check traces every train-step factory under every parallelism plan
+# on a virtual CPU mesh and enforces S1 collective order (SPMD deadlock),
+# S2 donation aliasing (silent HBM doubling), S3 single-trace (recompile
+# storm) and S4 static HBM budget at CUB geometry — the three most
+# expensive TPU failure modes, all decidable before paying for the pod.
+echo "$(date +%T) pre-flight: graftspmd jaxpr analysis (S1-S4)"
+if ! env JAX_PLATFORMS=cpu timeout 600 python tools/spmd_check.py \
+    --chip "${BABYSIT_CHIP:-v4-8}" \
+    --json "${CHIP_TMP}/chip_spmd_check.json" \
+    > "${CHIP_TMP}/chip_spmd_check.log" 2>&1; then
+  echo "$(date +%T) spmd check FAILED — refusing to arm the chip queue (see ${CHIP_TMP}/chip_spmd_check.log)"
+  exit 1
+fi
+echo "$(date +%T) spmd check PASS"
+
 # -- optional training auto-restart supervisor -----------------------------
 # Arm with BABYSIT_TRAIN_CMD="python train_dalle.py --image_text_folder ..."
 # (do NOT include --resume/--heartbeat_dir — the supervisor adds them).

@@ -14,6 +14,8 @@ Usage:
         train_dalle.py genrank.py
     python tools/graftlint.py --select ENV001 --fix dalle_pytorch_tpu
     python tools/graftlint.py --write-baseline ...   # grandfather findings
+    python tools/graftlint.py --format json --output lint.json ...  # CI
+    python tools/graftlint.py --prune-baseline ...   # drop stale entries
 
 Suppress a finding inline WITH a justification (enforced — a bare pragma
 is itself an error):
@@ -24,6 +26,7 @@ Exit codes: 0 clean, 1 findings, 2 usage error.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -31,9 +34,10 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 from dalle_pytorch_tpu.lint import (RULES, filter_baseline,  # noqa: E402
+                                    findings_to_json, findings_to_sarif,
                                     fix_env001, iter_python_files,
-                                    lint_paths, load_baseline,
-                                    write_baseline)
+                                    lint_paths, load_baseline, prune_baseline,
+                                    stale_baseline_entries, write_baseline)
 
 DEFAULT_BASELINE = REPO / ".graftlint-baseline.json"
 
@@ -56,6 +60,17 @@ def main(argv=None) -> int:
     parser.add_argument("--write-baseline", action="store_true",
                         help="write the current findings to the baseline "
                              "file and exit 0")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="drop baseline fingerprints matching no "
+                             "current finding, then exit 0")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text",
+                        help="findings output format (default: text; json "
+                             "follows lint.FINDINGS_JSON_SCHEMA, sarif is "
+                             "SARIF 2.1.0 for code-scanning UIs)")
+    parser.add_argument("--output", type=Path, default=None,
+                        help="write --format json/sarif document here "
+                             "instead of stdout (text stays on stdout)")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -92,18 +107,47 @@ def main(argv=None) -> int:
         write_baseline(findings, baseline_path)
         print(f"baseline: {len(findings)} finding(s) -> {baseline_path}")
         return 0
-    findings = filter_baseline(findings, load_baseline(baseline_path))
+    if args.prune_baseline:
+        stale = prune_baseline(findings, baseline_path)
+        print(f"--prune-baseline: dropped {len(stale)} stale "
+              f"fingerprint(s) from {baseline_path}")
+        for fp in stale:
+            print(f"  {fp}")
+        return 0
+    baseline = load_baseline(baseline_path)
+    stale = stale_baseline_entries(findings, baseline)
+    findings = filter_baseline(findings, baseline)
 
-    for f in findings:
-        print(f.format())
-    if findings:
-        counts: dict = {}
+    n_files = len(iter_python_files(args.paths))
+    if args.format != "text":
+        doc = (findings_to_json(findings, files_scanned=n_files)
+               if args.format == "json" else findings_to_sarif(findings))
+        text = json.dumps(doc, indent=2) + "\n"
+        if args.output:
+            args.output.write_text(text)
+            print(f"{args.format} findings -> {args.output}")
+        else:
+            sys.stdout.write(text)
+    else:
         for f in findings:
-            counts[f.rule] = counts.get(f.rule, 0) + 1
-        summary = ", ".join(f"{r}: {n}" for r, n in sorted(counts.items()))
-        print(f"\n{len(findings)} finding(s) ({summary})")
+            print(f.format())
+    # stale entries warn (stderr — machine formats keep a clean stdout)
+    # but don't fail the run: they mask nothing yet, they only risk
+    # shadowing a future same-line regression
+    for fp in stale:
+        print(f"warning: stale baseline entry {fp} matches no current "
+              "finding (prune with --prune-baseline)", file=sys.stderr)
+    if findings:
+        if args.format == "text":
+            counts: dict = {}
+            for f in findings:
+                counts[f.rule] = counts.get(f.rule, 0) + 1
+            summary = ", ".join(
+                f"{r}: {n}" for r, n in sorted(counts.items()))
+            print(f"\n{len(findings)} finding(s) ({summary})")
         return 1
-    print(f"graftlint: clean ({len(iter_python_files(args.paths))} files)")
+    if args.format == "text":
+        print(f"graftlint: clean ({n_files} files)")
     return 0
 
 

@@ -1,0 +1,556 @@
+#!/usr/bin/env python
+"""graftspmd CLI — jaxpr-level SPMD analysis of every train-step factory.
+
+graftlint reads source and contract_check reads shapes; this tool reads
+the *traced programs*.  It builds every train-step factory in
+``training.py`` (``STEP_FACTORIES``) plus the decode path in
+``models/dalle.py`` under each parallelism plan on a virtual 8-device CPU
+mesh and enforces four analyses (``dalle_pytorch_tpu/lint/spmd.py``):
+
+* **S1 collective order** — the per-shard collective sequence is
+  identical and unconditionally executed: any psum/ppermute/all_gather/
+  all_to_all under data-dependent control flow (a ``while``, or ``cond``
+  branches with differing collective signatures) is an SPMD deadlock.
+* **S2 donation audit** — params and opt_state leaves of every donating
+  jit actually alias outputs (``args_info`` + the optimized HLO's
+  ``input_output_alias`` config — jax drops donation silently when a
+  donated input matches no output), and large (>1 MiB) undonated array
+  args are reported.
+* **S3 retrace sentinel** — N simulated steps per factory trace exactly
+  once; a weak-hash or unhashable static arg is a per-epoch recompile
+  storm.
+* **S4 static HBM budget** — per-device live bytes (args + outputs −
+  donated aliases + peak XLA temporaries, ``memory_analysis()``) of each
+  plan's step at the production CUB geometry must fit the target chip
+  (``--chip v4-8|v5e-4|cpu-virtual``).
+
+Zero chip time by the same construction as contract_check: AOT trace/
+lower/compile on CPU; only S3 executes, at toy geometry.  S2's alias
+check compiles at TINY geometry and full optimization (donation
+honoring is structural — and XLA's opt-level-0 path skips the alias
+passes entirely, reporting alias=0 for honored donations); S4 compiles
+the production geometry at backend optimization level 0 (argument/
+output/temp buffer assignment is identical, ~10x faster codegen on one
+core) and subtracts the S2-verified donated fraction in place of the
+opt0-zeroed alias stat.  ``tools/chip_babysitter.sh`` runs this as its
+second pre-flight gate, CI's lint job uploads the ``--json`` findings.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/spmd_check.py [--chip v4-8] [--quick]
+    python tools/spmd_check.py --selftest   # prove S1-S4 catch fixtures
+
+Exit 0 iff every analysis passes on every plan.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import os
+
+# Chip-free by construction: an 8-device virtual CPU mesh, forced BEFORE
+# jax initializes a backend (BACKEND001 — a pinned-but-down tunnel hangs
+# inside the first device query otherwise).
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+
+import jax
+
+from dalle_pytorch_tpu.cli import apply_platform_env, enable_compilation_cache
+
+apply_platform_env()
+enable_compilation_cache()
+
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu import DALLE, DALLEConfig
+from dalle_pytorch_tpu.lint import spmd
+from dalle_pytorch_tpu.models.clip import CLIP, CLIPConfig
+from dalle_pytorch_tpu.models.dalle import decode_codes
+from dalle_pytorch_tpu.models.vae import DiscreteVAE, VAEConfig
+from dalle_pytorch_tpu.parallel.mesh import Partitioner, make_mesh
+from dalle_pytorch_tpu.training import (STEP_FACTORIES,
+                                        make_clip_train_step,
+                                        make_dalle_pp_train_step,
+                                        make_dalle_sp_train_step,
+                                        make_dalle_train_step, make_optimizer,
+                                        make_vae_train_step)
+
+# Backend optimization level 0 skips the LLVM codegen passes whose output
+# S4 never reads — argument/output/temp buffer assignment is identical
+# (measured on the CUB dp step) but the ALIAS stat is not: opt0 also
+# skips XLA's input/output alias passes, so S2 never compiles with this
+# and S4 substitutes the S2-verified donated fraction for the alias term.
+OPT0 = {"xla_backend_optimization_level": 0}
+
+# The factories this harness knows how to build and feed.  A new entry in
+# training.STEP_FACTORIES without a harness here fails check_factory_
+# coverage (and the tests/test_spmd_check.py meta-test).
+HARNESSED_FACTORIES = frozenset(("vae", "dalle", "dalle_sp", "dalle_pp",
+                                 "clip"))
+
+# The parallelism plans of the DALLE model (contract_check C4's matrix
+# plus pp).  mesh kwargs feed make_mesh; plan kwargs feed DALLEConfig.
+PLANS = {
+    "dp": dict(mesh=dict(), plan=dict()),
+    "fsdp": dict(mesh=dict(fsdp=4), plan=dict()),
+    "tp": dict(mesh=dict(tp=2), plan=dict()),
+    "sp-ring": dict(mesh=dict(sp=2),
+                    plan=dict(ring_axis="sp", sp_impl="ring", sp_size=2)),
+    "sp-ulysses": dict(mesh=dict(sp=2),
+                       plan=dict(ring_axis="sp", sp_impl="ulysses",
+                                 sp_size=2)),
+    "pp": dict(mesh=dict(pp=2), plan=dict()),
+}
+
+DALLE_ARG_LABELS = ("params", "opt_state", "vae_params", "text", "codes",
+                    "rng", "fault_scale")
+VAE_ARG_LABELS = ("params", "opt_state", "images", "rng", "temp",
+                  "fault_scale")
+CLIP_ARG_LABELS = ("params", "opt_state", "text", "images", "text_mask",
+                   "fault_scale")
+
+
+# --- geometries (contract_check's twins) ----------------------------------
+
+
+def tiny_config(**overrides) -> DALLEConfig:
+    """Small geometry: seq 24 (divisible by sp=2), heads 4 (divisible by
+    the ulysses sp axis), depth 2 (divisible by pp=2)."""
+    base = dict(dim=32, depth=2, heads=4, dim_head=8, num_text_tokens=50,
+                text_seq_len=8, num_image_tokens=32, image_size=64,
+                image_fmap_size=4)
+    base.update(overrides)
+    return DALLEConfig(**base)
+
+
+def cub_config(**overrides) -> DALLEConfig:
+    """The production CUB-200 geometry (bench.py::cub200_config shapes) at
+    the checkpoint-eval dtype (f32 activations)."""
+    base = dict(dim=256, depth=8, heads=8, dim_head=64,
+                num_text_tokens=7800, text_seq_len=80,
+                num_image_tokens=1024, image_size=256, image_fmap_size=32)
+    base.update(overrides)
+    return DALLEConfig(**base)
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _zeros_like_tree(sds_tree):
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), sds_tree)
+
+
+# --- per-factory setups ---------------------------------------------------
+
+
+def dalle_step_lowered(plan: str, make_cfg=cub_config, batch: int = 8):
+    """AOT-lower (and return labels for) the DALLE train step under one
+    parallelism plan — health-enabled, donating, input shardings as the
+    trainers place them (batch over the data axes, params as the
+    Partitioner rules shard them, replicated under shard_map plans)."""
+    spec = PLANS[plan]
+    cfg = make_cfg(**spec["plan"])
+    dalle = DALLE(cfg)
+    tx = make_optimizer(1e-3)
+    mesh = make_mesh(**spec["mesh"])
+    text = _sds((batch, cfg.text_seq_len), jnp.int32)
+    codes = _sds((batch, cfg.image_seq_len), jnp.int32)
+    rng = _sds((2,), jnp.uint32)
+    fs = _sds((), jnp.float32)
+    variables = jax.eval_shape(dalle.init, jax.random.PRNGKey(0), text,
+                               codes)
+    params = variables["params"]
+
+    if plan == "pp":
+        # the pp factory restructures CONCRETE params (stage stacking)
+        step, pp_params = make_dalle_pp_train_step(
+            dalle, tx, _zeros_like_tree(params), mesh, num_microbatches=2,
+            health=True)
+        opt = jax.eval_shape(tx.init, pp_params)
+        lowered = step.lower(pp_params, opt, None, text, codes, rng, fs)
+    elif cfg.ring_axis is not None:
+        step = make_dalle_sp_train_step(dalle, tx, mesh, health=True)
+        opt = jax.eval_shape(tx.init, params)
+        lowered = step.lower(params, opt, None, text, codes, rng, fs)
+    else:
+        pt = Partitioner(mesh=mesh)
+        sharded = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            params, pt.param_shardings(params))
+        opt = jax.eval_shape(tx.init, params)
+        opt_sharded = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            opt, pt.param_shardings(opt))
+        data = lambda s: jax.ShapeDtypeStruct(  # noqa: E731
+            s.shape, s.dtype, sharding=pt.data_sharding)
+        step = make_dalle_train_step(dalle, tx, health=True, partitioner=pt)
+        lowered = step.lower(sharded, opt_sharded, None, data(text),
+                             data(codes), rng, fs)
+    return lowered
+
+
+def tiny_dalle_concrete(plan: str, batch: int = 8):
+    # batch 8: under pp the per-microbatch rows (batch/2) must divide the
+    # dp axis (4 ways on the 8-device (dp, pp) mesh)
+    """Concrete tiny step + fresh-args generator for S1 (jaxpr) and S3
+    (trace counting).  donate=False: S3 reuses the same concrete
+    params/opt across simulated steps."""
+    spec = PLANS[plan]
+    cfg = tiny_config(**spec["plan"])
+    dalle = DALLE(cfg)
+    tx = make_optimizer(1e-3)
+    mesh = make_mesh(**spec["mesh"])
+    text = jnp.zeros((batch, cfg.text_seq_len), jnp.int32)
+    codes = jnp.zeros((batch, cfg.image_seq_len), jnp.int32)
+    variables = dalle.init(jax.random.PRNGKey(0), text, codes)
+    params = variables["params"]
+    if plan == "pp":
+        step, params = make_dalle_pp_train_step(
+            dalle, tx, params, mesh, num_microbatches=2, donate=False,
+            health=True)
+    elif cfg.ring_axis is not None:
+        step = make_dalle_sp_train_step(dalle, tx, mesh, donate=False,
+                                        health=True)
+    else:
+        step = make_dalle_train_step(dalle, tx, donate=False, health=True)
+    opt = tx.init(params)
+
+    def make_args(i):
+        r = np.random.RandomState(i)
+        return (params, opt, None,
+                jnp.asarray(r.randint(1, 40, size=(batch, cfg.text_seq_len)),
+                            jnp.int32),
+                jnp.asarray(r.randint(0, cfg.num_image_tokens,
+                                      size=(batch, cfg.image_seq_len)),
+                            jnp.int32),
+                jnp.asarray([i, i + 1], jnp.uint32), jnp.float32(1.0))
+
+    # S2 for the dalle factories runs at production geometry via
+    # dalle_step_lowered — no donating twin needed here
+    return step, make_args, None
+
+
+def tiny_vae_concrete(batch: int = 4):
+    cfg = VAEConfig(image_size=16, num_tokens=16, codebook_dim=16,
+                    num_layers=1, hidden_dim=16)
+    vae = DiscreteVAE(cfg)
+    tx = make_optimizer(1e-3)
+    images = jnp.zeros((batch, 16, 16, 3), jnp.float32)
+    params = vae.init(jax.random.PRNGKey(0), images,
+                      rng=jax.random.PRNGKey(1))["params"]
+    # donate=False for S3 (the same concrete params feed N simulated
+    # steps); the donating twin the trainers actually run feeds S2
+    step = make_vae_train_step(vae, tx, donate=False, health=True)
+    donating = make_vae_train_step(vae, tx, health=True)
+    opt = tx.init(params)
+
+    def make_args(i):
+        r = np.random.RandomState(i)
+        return (params, opt,
+                jnp.asarray(r.rand(batch, 16, 16, 3), jnp.float32),
+                jnp.asarray([i, i + 1], jnp.uint32),
+                jnp.float32(0.9 / (i + 1)), jnp.float32(1.0))
+
+    return step, make_args, donating
+
+
+def tiny_clip_concrete(batch: int = 4):
+    cfg = CLIPConfig(dim_text=16, dim_image=16, dim_latent=16,
+                     num_text_tokens=64, text_enc_depth=1, text_seq_len=8,
+                     text_heads=2, num_visual_tokens=64, visual_enc_depth=1,
+                     visual_heads=2, visual_image_size=16,
+                     visual_patch_size=8)
+    clip = CLIP(cfg)
+    tx = make_optimizer(1e-3)
+    text = jnp.zeros((batch, cfg.text_seq_len), jnp.int32)
+    images = jnp.zeros((batch, 16, 16, 3), jnp.float32)
+    mask = jnp.ones((batch, cfg.text_seq_len), bool)
+    params = clip.init(jax.random.PRNGKey(0), text, images,
+                       text_mask=mask)["params"]
+    step = make_clip_train_step(clip, tx, donate=False, health=True)
+    donating = make_clip_train_step(clip, tx, health=True)
+    opt = tx.init(params)
+
+    def make_args(i):
+        r = np.random.RandomState(i)
+        return (params, opt,
+                jnp.asarray(r.randint(1, 63, size=(batch, cfg.text_seq_len)),
+                            jnp.int32),
+                jnp.asarray(r.rand(batch, 16, 16, 3), jnp.float32), mask,
+                jnp.float32(1.0))
+
+    return step, make_args, donating
+
+
+TINY_FACTORY_SETUPS = {
+    "vae": tiny_vae_concrete,
+    "clip": tiny_clip_concrete,
+    "dalle": lambda: tiny_dalle_concrete("dp"),
+    "dalle_sp": lambda: tiny_dalle_concrete("sp-ring"),
+    "dalle_pp": lambda: tiny_dalle_concrete("pp"),
+}
+
+FACTORY_ARG_LABELS = {
+    "vae": VAE_ARG_LABELS,
+    "clip": CLIP_ARG_LABELS,
+    "dalle": DALLE_ARG_LABELS,
+    "dalle_sp": DALLE_ARG_LABELS,
+    "dalle_pp": DALLE_ARG_LABELS,
+}
+
+
+def decode_jaxpr(make_cfg=tiny_config, batch: int = 2):
+    """Jaxpr of the sampling scan (prefill state -> image codes) — the
+    decode path S1 walks.  Collective-free today; the analysis pins that
+    a future sharded sampler cannot regress it silently."""
+    cfg = make_cfg()
+    dalle = DALLE(cfg)
+    text = _sds((batch, cfg.text_seq_len), jnp.int32)
+    codes = _sds((batch, cfg.image_seq_len), jnp.int32)
+    variables = jax.eval_shape(dalle.init, jax.random.PRNGKey(0), text,
+                               codes)
+    logits, kvs = jax.eval_shape(
+        lambda v, t: dalle.apply(v, t, method=DALLE.prefill), variables,
+        text)
+    rng = _sds((2,), jnp.uint32)
+
+    def run(v, first_logits, caches, rng):
+        return decode_codes(dalle, v, first_logits, caches, rng)
+
+    return jax.make_jaxpr(run)(variables, logits, kvs, rng)
+
+
+def check_factory_coverage() -> None:
+    """The registry/harness sync gate: every training.STEP_FACTORIES entry
+    has a harness here, and vice versa."""
+    missing = set(STEP_FACTORIES) - set(HARNESSED_FACTORIES)
+    stale = set(HARNESSED_FACTORIES) - set(STEP_FACTORIES)
+    if missing or stale:
+        raise spmd.SPMDViolation(
+            f"factory coverage drift: unanalyzed factories {sorted(missing)}"
+            f", harnesses without a factory {sorted(stale)} — update "
+            "tools/spmd_check.py HARNESSED_FACTORIES alongside "
+            "training.STEP_FACTORIES")
+
+
+# --- driver ---------------------------------------------------------------
+
+
+def run_all(chip: str = "v4-8", quick: bool = False,
+            json_out=None) -> int:
+    t_start = time.time()
+    results = []
+    failures = 0
+
+    def run(analysis: str, target: str, fn):
+        nonlocal failures
+        t0 = time.time()
+        try:
+            detail = fn() or ""
+            status = "PASS"
+        except spmd.SPMDViolation as e:
+            detail, status = str(e), "FAIL"
+            failures += 1
+        # graftlint: disable=EXC001 (recorded as an ERROR result that fails the run — nothing is swallowed)
+        except Exception as e:  # harness breakage is a failure, not a pass
+            detail, status = f"{type(e).__name__}: {e}", "ERROR"
+            failures += 1
+        results.append(dict(analysis=analysis, target=target, status=status,
+                            detail=str(detail)))
+        print(f"{status} {analysis} [{target}] "
+              f"({time.time() - t0:.1f}s){': ' + str(detail) if status != 'PASS' else ''}")
+
+    run("coverage", "step-factories", check_factory_coverage)
+
+    # S1 + S3 per factory at tiny geometry (jaxpr structure and trace
+    # caching are geometry-independent; S3 is the one analysis that
+    # executes, so it must stay toy-sized)
+    donating_twins = {}
+    for name, setup in TINY_FACTORY_SETUPS.items():
+        try:
+            step, make_args, donating = setup()
+        # graftlint: disable=EXC001 (rethrown into run(), which records a counted ERROR — nothing is swallowed)
+        except Exception as e:
+            run("setup", name, lambda e=e: (_ for _ in ()).throw(e))
+            continue
+        donating_twins[name] = (donating, make_args)
+        args0 = make_args(0)
+        run("S1-collectives", name, lambda s=step, a=args0, n=name: "; ".join(
+            x.format() for x in spmd.check_collective_order(
+                jax.make_jaxpr(s)(*a), label=n)) or "no collectives")
+        run("S3-retrace", name,
+            lambda s=step, m=make_args, n=name:
+                spmd.check_single_trace(s, m, steps=3, label=n))
+    run("S1-collectives", "decode",
+        lambda: "; ".join(x.format() for x in spmd.check_collective_order(
+            decode_jaxpr(), label="decode")) or "no collectives")
+
+    # S2 per plan at tiny geometry, FULL-opt compile (donation honoring
+    # is structural — layout/sharding mismatches reproduce at any size —
+    # and only the full pipeline runs XLA's alias passes; opt0 reports
+    # alias=0 even for honored donations).  S4 per plan at the
+    # production geometry, opt0 (sizes only); --quick drops S4 to tiny
+    # geometry too, for the test suite.
+    make_cfg = tiny_config if quick else cub_config
+
+    def s2_plan(plan):
+        low_tiny = dalle_step_lowered(plan, make_cfg=tiny_config)
+        with spmd.fresh_stats_compile():
+            c_tiny = low_tiny.compile()
+        return _s2_detail(spmd.check_donation(
+            low_tiny, DALLE_ARG_LABELS, (0, 1), compiled=c_tiny,
+            label=f"dalle/{plan}"))
+
+    def s4_plan(plan):
+        lowered = dalle_step_lowered(plan, make_cfg=make_cfg)
+        with spmd.fresh_stats_compile():
+            compiled = lowered.compile(OPT0)
+        return _s4_detail(compiled, lowered, chip, f"dalle/{plan}")
+
+    for plan in PLANS:
+        run("S2-donation", f"dalle/{plan}", lambda p=plan: s2_plan(p))
+        run("S4-hbm", f"dalle/{plan}@{chip}", lambda p=plan: s4_plan(p))
+
+    # S2 for the single-chip factories (tiny compile: donation is
+    # size-independent, the alias check still needs an executable)
+    for name in ("vae", "clip"):
+        if name not in donating_twins:
+            continue  # setup already reported the failure
+        donating, make_args = donating_twins[name]
+        lowered = donating.lower(*make_args(0))
+        with spmd.fresh_stats_compile():
+            compiled = lowered.compile()
+        run("S2-donation", name,
+            lambda lo=lowered, c=compiled, n=name: _s2_detail(
+                spmd.check_donation(lo, FACTORY_ARG_LABELS[n], (0, 1),
+                                    compiled=c, label=n)))
+
+    elapsed = time.time() - t_start
+    print(f"\nspmd_check: {'FAIL' if failures else 'PASS'} "
+          f"({failures} violation(s), {elapsed:.0f}s, chip={chip})")
+    if json_out:
+        Path(json_out).write_text(json.dumps(
+            dict(tool="spmd_check", chip=chip, quick=quick,
+                 failures=failures, results=results), indent=2) + "\n")
+        print(f"findings -> {json_out}")
+    return 1 if failures else 0
+
+
+def _s2_detail(audit: spmd.DonationAudit) -> str:
+    mib = 1024 ** 2
+    big = "; ".join(f"{lbl}/{p} {b / mib:.1f} MiB undonated"
+                    for lbl, p, b in audit.undonated_big[:4])
+    return (f"donated {audit.donated_bytes / mib:.1f} MiB across "
+            f"{audit.donated_leaves} leaves, {audit.aliased_params} aliased"
+            + (f"; large undonated args: {big}" if big else ""))
+
+
+def _s4_detail(compiled, lowered, chip: str, label: str) -> str:
+    est = spmd.hbm_estimate(compiled)
+    # opt0 zeroes the compiled alias stat; S2 verified the donation
+    # aliases for this plan, so subtract the requested-donated share of
+    # the per-device argument bytes in its place (donated and undonated
+    # args shard across the same mesh, so the global fraction holds
+    # per-device)
+    audit = spmd.audit_donation(lowered, DALLE_ARG_LABELS, (0, 1))
+    assumed = int(audit.donated_fraction * est.argument_bytes)
+    est = dataclasses.replace(est, alias_bytes=max(est.alias_bytes, assumed))
+    spmd.check_hbm_budget(est, chip, label=label)
+    return est.format()
+
+
+# --- selftest: the analyses catch their broken fixtures -------------------
+
+
+def selftest() -> int:
+    """Prove S1-S4 have teeth against lint/spmd_fixtures.py (the CLI twin
+    of tests/test_spmd_check.py)."""
+    from dalle_pytorch_tpu.lint import spmd_fixtures as fx
+
+    failures = 0
+
+    def expect_catch(label, fn):
+        nonlocal failures
+        try:
+            fn()
+        except spmd.SPMDViolation as e:
+            print(f"PASS {label}: caught ({str(e)[:90]}...)")
+        else:
+            print(f"FAIL {label}: broken fixture NOT caught")
+            failures += 1
+
+    mesh = make_mesh()
+    x = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+    expect_catch("S1 conditional ppermute", lambda: spmd.check_collective_order(
+        jax.make_jaxpr(fx.make_conditional_collective_step(mesh))(x)))
+    spmd.check_collective_order(
+        jax.make_jaxpr(fx.make_branch_matched_collective_step(mesh))(x))
+    print("PASS S1 branch-matched twin: clean")
+
+    tx = make_optimizer(1e-3)
+    params = fx.fixture_params()
+    opt = tx.init(params)
+    low = fx.make_undonated_train_step(tx).lower(
+        params, opt, jnp.ones((8, 64), jnp.float32))
+    expect_catch("S2 dropped donation", lambda: spmd.check_donation(
+        low, ("params", "opt_state", "batch"), (0, 1)))
+
+    expect_catch("S3 weak-hash static arg", lambda: spmd.check_single_trace(
+        *fx.make_retracing_step()))
+    expect_catch("S3 unhashable static arg", lambda: spmd.check_single_trace(
+        *fx.make_unhashable_static_step()))
+    spmd.check_single_trace(*fx.make_stable_step())
+    print("PASS S3 stable twin: clean")
+
+    est = spmd.hbm_estimate(fx.oversized_step_compiled())
+    toy = dict(spmd.CHIP_HBM_BYTES, toy=1 << 20)
+    expect_catch("S4 oversized plan", lambda: _gate_with(toy, est))
+
+    print(f"\nselftest: {'FAIL' if failures else 'PASS'}")
+    return 1 if failures else 0
+
+
+def _gate_with(table, est):
+    orig = dict(spmd.CHIP_HBM_BYTES)
+    spmd.CHIP_HBM_BYTES.clear()
+    spmd.CHIP_HBM_BYTES.update(table)
+    try:
+        spmd.check_hbm_budget(est, "toy")
+    finally:
+        spmd.CHIP_HBM_BYTES.clear()
+        spmd.CHIP_HBM_BYTES.update(orig)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--chip", default="v4-8",
+                        choices=sorted(spmd.CHIP_HBM_BYTES),
+                        help="HBM capacity table for the S4 budget gate")
+    parser.add_argument("--quick", action="store_true",
+                        help="tiny geometry for S2/S4 too (tests/dev)")
+    parser.add_argument("--json", type=str, default=None,
+                        help="write machine-readable results to this path")
+    parser.add_argument("--selftest", action="store_true",
+                        help="prove each analysis catches its deliberately-"
+                             "broken fixture, then exit")
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    return run_all(chip=args.chip, quick=args.quick, json_out=args.json)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

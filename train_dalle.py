@@ -688,13 +688,15 @@ def _main(argv, lr_scale=1.0, skip_past=None):
         # frozen DiscreteVAE tokenizes images inside the jitted step
         train_step = make_dalle_train_step(dalle, tx, vae=vae,
                                            health=health_on,
-                                           guard=health_guard)
+                                           guard=health_guard,
+                                           partitioner=part)
     else:
         # pretrained wrapper: encode outside (its params are jit-captured
         # constants), feed codes into a codes-only step
         _codes_step = make_dalle_train_step(dalle, tx, vae=None,
                                             health=health_on,
-                                            guard=health_guard)
+                                            guard=health_guard,
+                                            partitioner=part)
         encode_fn = jax.jit(vae.get_codebook_indices)
 
         def train_step(params, opt_state, _vae_params, text, images, rng,

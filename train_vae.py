@@ -306,7 +306,7 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     health_on = args.health != 'off'
     train_step = make_vae_train_step(
         vae, tx, health=health_on,
-        guard=args.health in ('skip', 'rollback'))
+        guard=args.health in ('skip', 'rollback'), partitioner=part)
 
     sched = ExponentialDecay(LEARNING_RATE, LR_DECAY_RATE)
     temp_sched = GumbelTemperature(STARTING_TEMP, TEMP_MIN, ANNEAL_RATE)
