@@ -293,6 +293,86 @@ def make_gen_measure_deferred(batch: int = 8, **overrides):
     return compile_fn, cfg
 
 
+def make_serve_measure(num_slots: int = 64, requests_per_slot: int = 2,
+                       oversubscribe: float = 1.25, **overrides):
+    """Compile the continuous-batching generation service
+    (serve.GenerationServer over the slot-based KV arena) at the CUB
+    geometry; each ``measure()`` drives a synthetic OPEN-LOOP arrival
+    trace and returns ``(aggregate_image_tokens_per_sec, dt)``.
+
+    The trace is calibrated from a closed-loop warm-up run: arrivals are
+    spaced at ``service_time / num_slots / oversubscribe`` so ingress
+    slightly outpaces service — the queue stays non-empty, slots refill
+    the tick they free, and the measured number is sustained
+    continuous-batching throughput with requests arriving mid-flight (the
+    ROADMAP direction-1 scenario), directly comparable to the static
+    ``gen64`` A/B at ``num_slots=64``.  Per-request p50/p99 latency, slot
+    occupancy and the no-recompile sentinel are printed to stderr
+    (PERF.md "Serve throughput/latency" row schema).  ``overrides``
+    replace DALLEConfig fields, exactly like ``make_gen_measure``."""
+    import dataclasses
+
+    import numpy as np
+
+    from dalle_pytorch_tpu import DALLE
+    from dalle_pytorch_tpu.serve import GenerationServer
+
+    cfg = cub200_config()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    model = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    text = np.asarray(jax.random.randint(
+        rng, (cfg.text_seq_len,), 0, cfg.num_text_tokens), np.int32)
+    params = jax.jit(lambda r: model.init(
+        r, jnp.asarray(text)[None],
+        jnp.zeros((1, cfg.image_seq_len), jnp.int32)))(rng)
+    server = GenerationServer(model, params, num_slots=num_slots,
+                              filter_thres=0.9)
+
+    # two closed-loop warm-up passes: the first pays every compile
+    # (prefill/admit/tick), the second — compile-warm — calibrates the
+    # per-request service time the open loop is paced by (calibrating on
+    # the cold pass would stretch the arrival gap by the compile time and
+    # the "open-loop" trace would never saturate the slots)
+    def closed_loop(seed):
+        t0 = time.perf_counter()
+        for i in range(num_slots):
+            server.submit(text, key=np.asarray([seed, i], np.uint32))
+        server.run_until_idle(max_ticks=4 * cfg.image_seq_len)
+        server.reset()
+        return time.perf_counter() - t0
+
+    closed_loop(7)
+    service_time = closed_loop(8)
+    gap = service_time / num_slots / oversubscribe
+
+    n_requests = num_slots * requests_per_slot
+
+    def measure():
+        arrivals = [(i * gap,
+                     dict(text=text, key=np.asarray([13, i], np.uint32)))
+                    for i in range(n_requests)]
+        t0 = time.perf_counter()
+        stats = server.drive(arrivals,
+                             max_ticks=4 * n_requests * cfg.image_seq_len)
+        dt = time.perf_counter() - t0
+        assert stats["failed"] == 0, f"{stats['failed']} serve failures"
+        assert stats["trace_counts"] == {
+            "prefill": 1, "admit": 1, "tick": 1}, (
+            f"serve retraced mid-drive: {stats['trace_counts']}")
+        lp50, lp99 = stats["latency_p50"], stats["latency_p99"]
+        print(f"serve[{num_slots} slots]: occupancy "
+              f"{stats['occupancy']:.2f}, p50 "
+              f"{lp50['throughput']:.2f}s, p99 {lp99['throughput']:.2f}s, "
+              f"{stats['completed']} requests, "
+              f"{stats['preemptions']} preemptions", file=sys.stderr)
+        server.reset()
+        return stats["decoded_tokens"] / dt, dt
+
+    return measure
+
+
 def make_fused_rank_measure(batch: int = 8, num_images: int = 16,
                             **overrides):
     """Compile the fused generate -> VAE-decode -> CLIP-rerank pipeline
@@ -647,6 +727,29 @@ def main():
                             "value": round(vae_result[0], 2),
                             "unit": "images/sec",
                             "meta": {"batch": 8}})
+    if env_flag("BENCH_SERVE"):  # opt-in continuous-batching serve stage
+        serve_slots = int(os.environ.get("BENCH_SERVE_SLOTS", "64"))
+        # compile bound mirrors the gen stages: the serve tick compile is
+        # one decode step (cheap), but the warm-up also runs a full
+        # closed-loop pass over every slot
+        serve_measure = bounded_stage(
+            f"serve-s{serve_slots}-compile",
+            lambda: make_serve_measure(num_slots=serve_slots),
+            lambda _: f"serve arena ({serve_slots} slots) compiled + "
+                      "calibrated",
+            timeout_s=gen_compile_s)
+        if serve_measure is not None:
+            serve_result = bounded_stage(
+                f"serve-s{serve_slots}", serve_measure,
+                lambda r: f"serve ({serve_slots} slots, open-loop): "
+                          f"{r[0]:.1f} image-tokens/sec aggregate")
+            if serve_result is not None:
+                record_history({
+                    "metric": "dalle_cub200_serve_throughput",
+                    "value": round(serve_result[0], 1),
+                    "unit": "image_tokens/sec",
+                    "meta": {"slots": serve_slots, "open_loop": True,
+                             "oversubscribe": 1.25}})
 
 
 if __name__ == "__main__":

@@ -179,3 +179,30 @@ def oversized_step_compiled(mib: int = 64):
     b = jax.ShapeDtypeStruct((n * 16, 8), jnp.float32)
     with spmd.fresh_stats_compile():  # cached executables report zero stats
         return jax.jit(step).lower(a, b).compile()
+
+
+# --- S3 (serve): a shape-changing decode tick -----------------------------
+
+
+def make_shape_changing_serve_tick(num_slots: int = 4):
+    """The continuous-batching anti-pattern the serve arena exists to
+    prevent: a decode tick whose cache tensors are CROPPED to the current
+    occupancy ("why compute the idle slots?").  Every occupancy change is
+    a new shape, so admitting or retiring one request recompiles the tick
+    — on a real pod that is a recompile per arrival, the exact storm the
+    S3 serve gate (tools/spmd_check.py serve-tick harness) pins the real
+    arena against.  Returns ``(jitted, make_args)``: ``make_args(i)``
+    cycles through occupancies 1..num_slots like an admit/retire churn.
+    Must FAIL check_single_trace."""
+
+    def tick(caches, codes):
+        return caches + 1.0, codes + 1
+
+    jitted = jax.jit(tick)
+
+    def make_args(i):
+        n = (i % num_slots) + 1  # occupancy churn: 1, 2, ..., S, 1, ...
+        return (jnp.zeros((n, 8, 16), jnp.float32),
+                jnp.zeros((n,), jnp.int32))
+
+    return jitted, make_args

@@ -528,23 +528,60 @@ class DALLE(nn.Module):
         logits = self._head(last, image_only=True)
         return logits[:, 0], kvs
 
-    def decode_step(self, code, caches, index, mask=None):
+    def decode_step(self, code, caches, index, mask=None, write_pos=None):
         """One sampled image code in, next-position logits out.
 
         `code` [b] is the image-vocab token at *input* position `index`
         (traced); returns ([b, num_image_tokens] image-phase logits, new
         caches) — text logits would be -inf here (ref mask :482-484) and
-        are never computed."""
+        are never computed.
+
+        With ``write_pos`` (the serving arena's phase-aligned mode, see
+        ops/attention.py), ``index`` may be a per-row [b] vector — every
+        row decodes at its own depth against rotated caches that all
+        write the same physical column."""
         cfg = self.cfg
         emb = self.image_emb(code[:, None])
         img_index = index - (cfg.text_seq_len + 1)
         pos_grid = self.image_pos_emb(cfg.image_seq_len)
-        emb = emb + jax.lax.dynamic_slice_in_dim(pos_grid, img_index, 1, axis=0)[None]
+        if jnp.ndim(index) > 0:
+            # per-row positions: gather each row's pos-emb (clipped like
+            # dynamic_slice clamps — idle serve slots park out of range)
+            rows = jnp.clip(img_index, 0, cfg.image_seq_len - 1)
+            emb = emb + jnp.take(pos_grid, rows, axis=0)[:, None]
+        else:
+            emb = emb + jax.lax.dynamic_slice_in_dim(
+                pos_grid, img_index, 1, axis=0)[None]
         x = emb.astype(cfg.dtype)
         out, caches = self.transformer.decode_step(
-            x, caches, index, mask=self._pad_mask_for_bos(mask))
+            x, caches, index, mask=self._pad_mask_for_bos(mask),
+            write_pos=write_pos)
         logits = self._head(out, image_only=True)
         return logits[:, 0], caches
+
+
+def sample_image_code(logits, key, *, k_vocab: int,
+                      filter_thres: float = 0.5, temperature=1.0,
+                      top_p: Optional[float] = None) -> jax.Array:
+    """Sample image codes from image-phase logits ``[..., num_image_tokens]``.
+
+    THE sampling semantics of this repo, shared by ``decode_codes`` and the
+    serving tick (``serve/engine.py``) so the two paths cannot drift:
+    logits are image-vocab-only, ``k`` still derives from the full joint
+    vocab (reference semantics — its text entries were -inf and could never
+    win a slot), and the sampled index IS the image code (the reference's
+    ``- num_text_tokens`` offset is pre-applied by slicing).  Temperature
+    scales BEFORE the filters: top-k is invariant to the monotone rescale
+    (so reference top-k semantics are untouched) but the nucleus must be
+    the p-mass set of the distribution actually sampled.  ``temperature``
+    may be a traced scalar/array (the serve path carries it per request),
+    ``filter_thres``/``top_p`` stay static (``top_k_filter`` derives a
+    static k)."""
+    logits = logits / temperature
+    filtered = top_k_filter(logits, thres=filter_thres, k_vocab=k_vocab)
+    if top_p is not None:
+        filtered = top_p_filter(filtered, top_p)
+    return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
 
 
 def prefill_codes(dalle: DALLE, params, text, *, prime_codes=None,
@@ -592,21 +629,9 @@ def decode_codes(dalle: DALLE, params, first_logits, caches, rng, *,
     n_pre = cfg.text_seq_len + 1 + n_prime
 
     def sample(logits, key):
-        # logits are image-vocab-only; k still derives from the full joint
-        # vocab (reference semantics — its text entries were -inf and could
-        # never win a slot), and the sampled index IS the image code (the
-        # reference's `- num_text_tokens` offset is pre-applied by slicing).
-        # Temperature scales BEFORE the filters: top-k is invariant to the
-        # monotone rescale (so reference top-k semantics are untouched) but
-        # the nucleus must be the p-mass set of the distribution actually
-        # sampled.
-        logits = logits / temperature
-        filtered = top_k_filter(logits, thres=filter_thres,
-                                k_vocab=cfg.total_tokens)
-        if top_p is not None:
-            filtered = top_p_filter(filtered, top_p)
-        tok = jax.random.categorical(key, filtered, axis=-1)
-        return tok.astype(jnp.int32)
+        return sample_image_code(logits, key, k_vocab=cfg.total_tokens,
+                                 filter_thres=filter_thres,
+                                 temperature=temperature, top_p=top_p)
 
     rng, key0 = jax.random.split(rng)
     first_code = sample(first_logits, key0)

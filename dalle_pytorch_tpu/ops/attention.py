@@ -404,15 +404,34 @@ class MultiHeadAttention(nn.Module):
             return out, (k, v)
         return out
 
-    def decode_step(self, x, cache_k, cache_v, index, mask=None):
+    def decode_step(self, x, cache_k, cache_v, index, mask=None,
+                    write_pos=None):
         """Single-token decode with KV cache.
 
         x: [b, 1, dim]; cache_k/v: [b, heads, n_cache, dim_head]; `index` is
         the traced absolute position of this token.  Returns (out, new_k,
         new_v).
+
+        ``write_pos`` selects the PHASE-ALIGNED mode the serving arena
+        (serve/engine.py) runs in: ``index`` may then be a per-sequence
+        ``[b]`` vector (continuous batching: every sequence sits at its own
+        depth) while all rows write their k/v at the SAME physical cache
+        column ``write_pos`` (a traced scalar — the arena clock mod
+        n_cache).  Each row's cache is stored rotated by
+        ``r = (write_pos - index) mod n_cache``, so the one shared-column
+        ``dynamic_update_slice`` IS each row's logically-next position —
+        a per-row write position would lower to an XLA scatter, which
+        copies the whole cache on backends that don't alias it (measured
+        ~2x the decode step on CPU; the arena admit establishes the
+        rotation by rolling the prefilled caches once).  Masks translate
+        physical -> logical per row; with ``write_pos=None`` (the static
+        sampler) behavior is bit-identical to before the serve work.
         """
         b = x.shape[0]
         q, k, v = self._qkv(x)  # [b, h, 1, dh]
+        if write_pos is not None:
+            return self._decode_step_aligned(x, q, k, v, cache_k, cache_v,
+                                             index, write_pos, mask)
         cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
                                                (0, 0, index, 0))
         cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
@@ -488,6 +507,74 @@ class MultiHeadAttention(nn.Module):
         dots = jnp.where(row, dots, max_neg_value(dots.dtype))
         attn = jax.nn.softmax(dots, axis=-1)  # f32
         out = self._attn_v(attn, cache_v, x.dtype)
+        out = out.transpose(0, 2, 1, 3).reshape(b, 1, self.heads * self.dim_head)
+        return self.to_out(out), cache_k, cache_v
+
+    def _decode_step_aligned(self, x, q, k, v, cache_k, cache_v, index,
+                             write_pos, mask):
+        """Phase-aligned decode (see ``decode_step``): per-row logical
+        ``index`` [b] (or scalar, broadcast), one shared physical write
+        column ``write_pos``.  Row caches are rotated by
+        ``r = (write_pos - index) mod n``; attention reads the full cache
+        in physical order (sums are order-free) and masks by the LOGICAL
+        position of each physical column, which also hides the previous
+        resident's stale keys (they map to logical positions the causal
+        pattern can't reach).  The sliced-KV read becomes a per-row gather
+        at rotated positions — ``dynamic_slice`` can't span the circular
+        wrap."""
+        assert mask is None, (
+            "phase-aligned decode does not take a key padding mask; serve "
+            "requests carry fully-valid prompts")
+        b = x.shape[0]
+        n_k = cache_k.shape[2]
+        scale = self.dim_head ** -0.5
+        idx = jnp.broadcast_to(jnp.asarray(index, jnp.int32), (b,))
+        r = jnp.remainder(write_pos - idx, n_k)  # [b] rotation per row
+        # the ONE aligned write: every row's next token lands in the same
+        # physical column, so this stays a dynamic_update_slice (in-place
+        # under donation) instead of a scatter
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, k.astype(cache_k.dtype), (0, 0, write_pos, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, v.astype(cache_v.dtype), (0, 0, write_pos, 0))
+
+        sliced = (decode_key_positions(self.pattern, jnp.int32(0))
+                  if self.sliced_kv_decode else None)
+        if sliced is not None:
+            # batched positions: every row computes its own reachable set
+            # (decode_key_positions is shape-static over index, so the
+            # vmap is one gathered program, not b programs)
+            positions, valid, _ = jax.vmap(
+                lambda i: decode_key_positions(self.pattern, i))(idx)
+            valid = valid & (positions >= 0) & (positions < n_k)
+            safe = jnp.clip(positions, 0, n_k - 1)
+            phys = jnp.remainder(safe + r[:, None], n_k)     # [b, m]
+            k_sub = jnp.take_along_axis(
+                cache_k, phys[:, None, :, None], axis=2)     # [b, h, m, dh]
+            v_sub = jnp.take_along_axis(
+                cache_v, phys[:, None, :, None], axis=2)
+            dots = jnp.einsum("bhid,bhjd->bhij",
+                              (q * scale).astype(cache_k.dtype), k_sub,
+                              preferred_element_type=jnp.float32)
+            row = (_allowed(self.pattern, idx[:, None], positions, jnp)
+                   & valid)[:, None, None, :]
+            dots = jnp.where(row, dots, max_neg_value(dots.dtype))
+            attn = jax.nn.softmax(dots, axis=-1)  # f32
+            out = self._attn_v(attn, v_sub, x.dtype)
+        else:
+            dots = jnp.einsum("bhid,bhjd->bhij",
+                              (q * scale).astype(cache_k.dtype), cache_k,
+                              preferred_element_type=jnp.float32)
+            logical = jnp.remainder(
+                jnp.arange(n_k, dtype=jnp.int32)[None, :] - r[:, None], n_k)
+            layout = self.pattern.block_layout()
+            row = _allowed(self.pattern, idx[:, None], logical, jnp,
+                           layout=(jnp.asarray(layout)
+                                   if layout is not None else None))
+            dots = jnp.where(row[:, None, None, :], dots,
+                             max_neg_value(dots.dtype))
+            attn = jax.nn.softmax(dots, axis=-1)  # f32
+            out = self._attn_v(attn, cache_v, x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(b, 1, self.heads * self.dim_head)
         return self.to_out(out), cache_k, cache_v
 

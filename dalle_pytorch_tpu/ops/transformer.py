@@ -82,9 +82,11 @@ class AttnBlock(nn.Module):
             return h * self.scale.astype(h.dtype), kv
         return out * self.scale.astype(out.dtype)
 
-    def decode_step(self, x, cache_k, cache_v, index, mask=None):
+    def decode_step(self, x, cache_k, cache_v, index, mask=None,
+                    write_pos=None):
         h, ck, cv = self.attn.decode_step(
-            self.norm(x).astype(x.dtype), cache_k, cache_v, index, mask=mask
+            self.norm(x).astype(x.dtype), cache_k, cache_v, index, mask=mask,
+            write_pos=write_pos
         )
         return h * self.scale.astype(h.dtype), ck, cv
 
@@ -336,9 +338,13 @@ class Transformer(nn.Module):
             for _ in range(self.depth)
         ]
 
-    def decode_step(self, x, caches, index, mask=None):
+    def decode_step(self, x, caches, index, mask=None, write_pos=None):
         """Single-token pass: x [b, 1, dim], per-layer KV caches, traced
         absolute position `index`.  Returns (out, new_caches).
+
+        ``write_pos`` enables the phase-aligned serving mode (``index``
+        may be per-row, caches rotated, one shared physical write column —
+        see MultiHeadAttention.decode_step).
 
         Mirrors the executor the model trains with: residual stack, or the
         reversible two-stream recurrence (whose attention reads the x2
@@ -347,13 +353,15 @@ class Transformer(nn.Module):
         if self.reversible:
             x1 = x2 = x
             for attn, ff, (ck, cv) in zip(self.attn_blocks, self.ff_blocks, caches):
-                h, ck, cv = attn.decode_step(x2, ck, cv, index, mask=mask)
+                h, ck, cv = attn.decode_step(x2, ck, cv, index, mask=mask,
+                                             write_pos=write_pos)
                 x1 = x1 + h
                 x2 = x2 + ff(x1)
                 new_caches.append((ck, cv))
             return (x1 + x2) / 2, new_caches
         for attn, ff, (ck, cv) in zip(self.attn_blocks, self.ff_blocks, caches):
-            h, ck, cv = attn.decode_step(x, ck, cv, index, mask=mask)
+            h, ck, cv = attn.decode_step(x, ck, cv, index, mask=mask,
+                                         write_pos=write_pos)
             x = x + h
             x = x + ff(x)
             new_caches.append((ck, cv))

@@ -1,0 +1,20 @@
+"""Continuous-batching generation service (DESIGN.md §11).
+
+``engine.SlotArena`` is the device half: a fixed-shape slot-structured KV
+arena where admission/retirement are ``dynamic_update_slice``s and one
+jitted tick decodes every occupied slot under a per-slot active mask —
+never a shape change, never a retrace (gated by graftspmd's S3 serve
+check).  ``scheduler.GenerationServer`` is the host half: thread-safe
+request queue, iteration-level admission, SLO-aware scheduling
+(latency-class requests preempt throughput-class fills), and the
+per-request latency / aggregate throughput accounting ``bench_serve``
+reports.
+"""
+from .engine import ArenaGeometry, SlotArena
+from .scheduler import (LATENCY, SLO_CLASSES, THROUGHPUT, GenerationServer,
+                        ServeHandle)
+
+__all__ = [
+    "ArenaGeometry", "SlotArena", "GenerationServer", "ServeHandle",
+    "LATENCY", "THROUGHPUT", "SLO_CLASSES",
+]

@@ -1,0 +1,256 @@
+"""SlotArena: the fixed-shape KV-cache arena behind continuous batching.
+
+The static-batch sampler (``models/dalle.py::decode_codes``) turns one
+batch of one prompt into image codes at full device efficiency — but a
+*service* sees requests arriving at arbitrary times, and re-batching them
+into aligned cohorts leaves decode slots idle while stragglers finish (the
+head-of-line blocking the Orca iteration-level-scheduling paper measures).
+This module is the device half of the fix:
+
+* **One arena, N slots, every shape static.**  The KV caches live in
+  per-layer arrays ``[num_slots, heads, seq_len, dim_head]`` allocated
+  once.  A request occupies one slot; its per-slot decode position is a
+  *traced* ``int32``, so slots at different depths of their decode share
+  one compiled program.
+* **Admission is a ``dynamic_update_slice``, never a retrace.**  A new
+  request is prefilled at batch 1 (one compiled prefill shape), then its
+  caches are written into a free slot by the jitted :meth:`SlotArena.admit`
+  — the slot id is traced, so admitting into slot 0 and slot 17 is the
+  same executable.  Retiring a finished request is pure host bookkeeping
+  (the slot is marked free; its stale cache bytes are overwritten by the
+  next admit and are unreachable meanwhile — decode attention masks keys
+  beyond the slot's position).
+* **One jitted tick decodes every occupied slot.**  :meth:`SlotArena.tick`
+  runs the batched ``DALLE.decode_step`` with a per-slot position vector
+  and a per-slot active mask: occupied slots advance one token, free
+  slots burn a masked lane (fixed shapes are the point — the mask changes
+  per tick as requests come and go, but it is a *traced* input, so
+  occupancy changes never recompile).  graftspmd S3 gates exactly this
+  (``tools/spmd_check.py`` serve-tick harness): N simulated admit/retire
+  cycles across differing occupancies must leave ``_cache_size == 1`` on
+  every jitted entry point.
+* **Phase-aligned (circular) slot caches.**  Slots sit at different
+  depths, but a per-slot cache-write position would lower to an XLA
+  scatter — which copies the whole arena on backends that don't alias it
+  (measured ~2x the whole decode step on CPU).  Instead each slot's cache
+  is stored ROTATED by ``(clock - index) mod seq_len`` (established once
+  at admit by rolling the prefilled caches), so at every tick ALL slots
+  write their new k/v at the same physical column — the arena clock mod
+  seq_len — one plain in-place ``dynamic_update_slice``.  Attention masks
+  translate physical -> logical per slot (``ops/attention.py::
+  MultiHeadAttention._decode_step_aligned``), which also hides the
+  previous resident's stale keys.
+
+Sampling reuses ``models.dalle.sample_image_code`` — the serve path and
+``decode_codes`` share one sampler, so semantics cannot drift; temperature
+rides per-slot as a traced array (a per-request knob), while
+``filter_thres``/``top_p`` are server-static (they derive static shapes).
+
+The host-side queueing/SLO policy lives in ``serve/scheduler.py``; this
+module knows nothing about requests, only slots.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.dalle import DALLE, prefill_codes, sample_image_code
+
+
+@dataclasses.dataclass(frozen=True)
+class ArenaGeometry:
+    """Static facts of one arena build (host-side mirrors of the traced
+    state the scheduler needs for progress accounting)."""
+
+    num_slots: int
+    n_pre: int            # absolute input position of the first decode step
+    image_seq_len: int    # codes produced per request
+    seq_len: int
+
+
+class SlotArena:
+    """Device state + the three jitted entry points of the serving engine.
+
+    ``variables`` is the flax variables dict (``{"params": ...}``) the
+    generation primitives take.  All three entry points donate the arena
+    state, so the caches update in place; callers must always thread the
+    *returned* state (the donated input buffers are dead)."""
+
+    def __init__(self, dalle: DALLE, variables, num_slots: int, *,
+                 filter_thres: float = 0.9,
+                 top_p: Optional[float] = None):
+        cfg = dalle.cfg
+        self.dalle = dalle
+        self.variables = variables
+        self.geometry = ArenaGeometry(
+            num_slots=num_slots, n_pre=cfg.text_seq_len + 1,
+            image_seq_len=cfg.image_seq_len, seq_len=cfg.seq_len)
+        # cache STORAGE dtype matches what prefill returns (models/dalle.py
+        # casts to bf16 under kv_cache_bf16) — admit's astype is then a
+        # no-op and the arena carries the same byte-cut the static sampler
+        # measured (PERF.md: bf16 cache ≤0.6x cache I/O)
+        self._cache_dtype = (jnp.bfloat16 if cfg.kv_cache_bf16
+                             else cfg.dtype)
+        S = num_slots
+        cache_shape = (S, cfg.heads, cfg.seq_len, cfg.dim_head)
+
+        def fresh_state():
+            return dict(
+                caches=[(jnp.zeros(cache_shape, self._cache_dtype),
+                         jnp.zeros(cache_shape, self._cache_dtype))
+                        for _ in range(cfg.depth)],
+                code=jnp.zeros((S,), jnp.int32),
+                index=jnp.zeros((S,), jnp.int32),
+                pos=jnp.zeros((S,), jnp.int32),
+                # per-slot PRE-SPLIT key stream, one key per decoded code
+                # (decode_codes splits all its scan keys up front for the
+                # same reason: a threefry split inside the hot loop costs
+                # more than the toy-model decode step on CPU).  admit pays
+                # one vectorized split; the tick only gathers.
+                keys=jnp.zeros((S, cfg.image_seq_len, 2), jnp.uint32),
+                # temp divides logits — a zero in a never-admitted slot
+                # would poison that (masked) lane's sampler with inf/nan
+                temp=jnp.ones((S,), jnp.float32),
+                out=jnp.zeros((S, cfg.image_seq_len), jnp.int32),
+            )
+
+        self.state = jax.jit(fresh_state)()
+        n_pre = self.geometry.n_pre
+        k_vocab = cfg.total_tokens
+
+        def sample_one(logits, key, temp):
+            # [V] logits, [2] key, scalar temp -> scalar code; vmapped over
+            # the slot axis so each slot draws from its own request key
+            return sample_image_code(
+                logits, key, k_vocab=k_vocab, filter_thres=filter_thres,
+                temperature=temp, top_p=top_p)
+
+        def prefill(variables, text):
+            return prefill_codes(dalle, variables, text)
+
+        def admit(state, slot, first_logits, caches1, key, temp, write_pos):
+            """Install a batch-1 prefill into (traced) ``slot``: one
+            dynamic_update_slice per cache array, plus the request's first
+            sampled code — mirrors decode_codes' pre-scan sampling.
+
+            ``write_pos`` is the physical column the NEXT tick writes (the
+            arena clock mod seq_len): the prefill caches are rolled so the
+            slot's logical position ``n_pre`` lands exactly there —
+            establishing the rotation every later tick relies on to keep
+            its cache write one shared-column dynamic_update_slice."""
+            rot = jnp.remainder(write_pos - jnp.int32(n_pre),
+                                jnp.int32(self.geometry.seq_len))
+            caches = []
+            for (ak, av), (k1, v1) in zip(state["caches"], caches1):
+                ak = jax.lax.dynamic_update_slice(
+                    ak, jnp.roll(k1.astype(ak.dtype), rot, axis=2),
+                    (slot, 0, 0, 0))
+                av = jax.lax.dynamic_update_slice(
+                    av, jnp.roll(v1.astype(av.dtype), rot, axis=2),
+                    (slot, 0, 0, 0))
+                caches.append((ak, av))
+            ks = jax.random.split(key, self.geometry.image_seq_len)
+            code0 = sample_one(first_logits[0], ks[0], temp)
+
+            def set1(arr, val, dtype=None):
+                return jax.lax.dynamic_update_slice(
+                    arr, jnp.asarray(val, dtype or arr.dtype)[None], (slot,))
+
+            out_row = jnp.zeros((self.geometry.image_seq_len,), jnp.int32
+                                ).at[0].set(code0)
+            return dict(
+                caches=caches,
+                code=set1(state["code"], code0),
+                index=set1(state["index"], jnp.int32(n_pre)),
+                pos=set1(state["pos"], jnp.int32(1)),
+                keys=jax.lax.dynamic_update_slice(
+                    state["keys"], ks[None], (slot, 0, 0)),
+                temp=set1(state["temp"], temp),
+                out=jax.lax.dynamic_update_slice(
+                    state["out"], out_row[None], (slot, 0)),
+            )
+
+        def tick(variables, state, active, write_pos):
+            """One decode step over every slot (phase-aligned batched
+            ``DALLE.decode_step``: per-slot logical ``index`` vector, one
+            shared physical write column).  ``active`` [S] bool masks
+            which slots advance; masked lanes still compute (fixed shape)
+            but their code/pos/index/out are held, and their junk cache
+            write lands in the shared column — overwritten by the next
+            admit, unreachable before it (the aligned mask only reaches
+            logical positions a resident actually wrote)."""
+            logits, caches = dalle.apply(
+                variables, state["code"], state["caches"], state["index"],
+                None, write_pos, method=DALLE.decode_step)
+            # per-slot key for THIS position, gathered from the pre-split
+            # stream (no threefry in the tick)
+            sub = jax.vmap(
+                lambda ks, p: jax.lax.dynamic_slice(ks, (p, 0), (1, 2))[0])(
+                    state["keys"], state["pos"])
+            sampled = jax.vmap(sample_one)(logits, sub, state["temp"])
+
+            adv = active.astype(jnp.int32)
+            written = jax.vmap(
+                lambda row, p, val: jax.lax.dynamic_update_slice(
+                    row, val[None], (p,)))(state["out"], state["pos"], sampled)
+            return dict(
+                caches=caches,
+                code=jnp.where(active, sampled, state["code"]),
+                index=state["index"] + adv,
+                pos=state["pos"] + adv,
+                keys=state["keys"],
+                temp=state["temp"],
+                out=jnp.where(active[:, None], written, state["out"]),
+            )
+
+        self._prefill = jax.jit(prefill)
+        self._admit = jax.jit(admit, donate_argnums=(0,))
+        self._tick = jax.jit(tick, donate_argnums=(1,))
+
+    # --- public API (scheduler-facing) ------------------------------------
+
+    def prefill(self, text):
+        """Batch-1 prompt prefill: ``text`` [1, text_seq_len] int32 ->
+        (first_logits, caches) device state for :meth:`admit`.  One
+        compiled shape for every request."""
+        return self._prefill(self.variables, text)
+
+    def admit(self, slot: int, first_logits, caches1, key, temperature,
+              clock: int):
+        """Write a prefilled request into ``slot`` (traced — no retrace
+        across slots) and sample its first code.  ``clock`` is the arena
+        tick counter the NEXT tick will run at — it fixes the slot's
+        cache rotation.  Mutates ``self.state`` (donated)."""
+        self.state = self._admit(
+            self.state, jnp.int32(slot), first_logits, caches1,
+            jnp.asarray(key, jnp.uint32),
+            jnp.float32(temperature),
+            jnp.int32(clock % self.geometry.seq_len))
+
+    def tick(self, active_mask, clock: int):
+        """Advance every slot where ``active_mask`` [num_slots] bool is
+        set by one decoded token; ``clock`` is the arena tick counter
+        (all running slots write physical column ``clock % seq_len``).
+        Mutates ``self.state`` (donated)."""
+        self.state = self._tick(self.variables, self.state,
+                                jnp.asarray(active_mask),
+                                jnp.int32(clock % self.geometry.seq_len))
+
+    def fetch_codes(self, slot: int):
+        """Host numpy of one slot's decoded codes [image_seq_len] — the
+        retirement read.  Blocks until every dispatched tick touching the
+        slot has landed."""
+        return jax.device_get(self.state["out"][slot])
+
+    def trace_counts(self) -> dict:
+        """Executable-cache population per jitted entry point — the
+        no-recompile sentinel the S3 serve gate and tests assert on.  A
+        healthy server holds every count at 1 forever, whatever the
+        admit/retire pattern."""
+        return {name: int(fn._cache_size())
+                for name, fn in (("prefill", self._prefill),
+                                 ("admit", self._admit),
+                                 ("tick", self._tick))}

@@ -1,0 +1,352 @@
+"""GenerationServer: iteration-level scheduling over the slot arena.
+
+The host half of continuous batching (the device half is
+``serve/engine.py``).  One scheduler iteration (:meth:`GenerationServer.
+step`) is:
+
+1. **retire** — slots whose request decoded its last token are fetched to
+   host, their futures resolved, the slot freed;
+2. **admit** — queued requests are prefilled (batch 1) and written into
+   free slots, latency-class first.  When the latency queue is non-empty
+   and no slot is free, the least-progressed *throughput*-class running
+   request is **preempted**: its slot is reclaimed for the latency request
+   and it re-queues at the front of the throughput queue (restarting from
+   prefill — its key replays, so the restart is deterministic).  Latency
+   requests never preempt each other;
+3. **tick** — one jitted decode step advances every occupied slot.
+
+Requests enter through the thread-safe :meth:`GenerationServer.submit`,
+which returns a :class:`ServeHandle` carrying a ``concurrent.futures.
+Future`` (``asyncio`` callers wrap it with ``asyncio.wrap_future``).  The
+driving loop (:meth:`run_until_idle`, or :meth:`drive` for an open-loop
+arrival trace) runs in whatever thread the caller owns — tests and
+``bench_serve`` drive it synchronously for determinism; a daemon thread
+calling ``step()`` is the serve-forever deployment shape.
+
+Fault injection: every occupied slot hits the ``serve_request`` faultpoint
+once per tick (``GRAFT_FAULTS="serve_request:fail_after=N"``), so a
+mid-decode request failure is rehearsable: the failed request's future
+carries the fault, its slot frees the same iteration, and co-batched
+requests are untouched (tests/test_serve.py pins this).
+
+SLO accounting per request: queue wait (submit -> last admit), decode time
+(last admit -> finish), end-to-end latency, preemption count.
+:meth:`stats` aggregates p50/p99 latency, occupancy, and decoded-token
+throughput — the ``bench_serve`` row schema (PERF.md).
+"""
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import dataclasses
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import faults
+from .engine import SlotArena
+
+LATENCY = "latency"
+THROUGHPUT = "throughput"
+SLO_CLASSES = (LATENCY, THROUGHPUT)
+
+
+@dataclasses.dataclass
+class ServeHandle:
+    """One submitted request: its future plus the SLO bookkeeping."""
+
+    request_id: int
+    slo: str
+    temperature: float
+    text: np.ndarray                       # [1, text_seq_len] int32
+    key: np.ndarray                        # [2] uint32 — replays on restart
+    future: concurrent.futures.Future = dataclasses.field(
+        default_factory=concurrent.futures.Future)
+    submitted_at: float = 0.0
+    admitted_at: Optional[float] = None    # last admission (post-preemption)
+    finished_at: Optional[float] = None
+    preemptions: int = 0
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Decoded image codes [image_seq_len]; raises the request's
+        failure (e.g. an injected fault).  Only returns once the driving
+        loop has retired the request — call from a different thread than
+        the one stepping the server, or after ``run_until_idle``."""
+        return self.future.result(timeout)
+
+    @property
+    def latency(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+
+@dataclasses.dataclass
+class _Running:
+    handle: ServeHandle
+    done: int  # codes decoded so far (admit samples the first)
+
+
+class GenerationServer:
+    """Continuous-batching generation service over one DALLE model."""
+
+    def __init__(self, dalle, variables, num_slots: int = 8, *,
+                 filter_thres: float = 0.9, top_p: Optional[float] = None,
+                 seed: int = 0, time_fn=time.monotonic):
+        self.arena = SlotArena(dalle, variables, num_slots,
+                               filter_thres=filter_thres, top_p=top_p)
+        self.num_slots = num_slots
+        self._time = time_fn
+        self._seed = seed
+        self._lock = threading.Lock()
+        self._queues: Dict[str, Deque[ServeHandle]] = {
+            LATENCY: collections.deque(), THROUGHPUT: collections.deque()}
+        self._running: Dict[int, _Running] = {}       # slot -> running
+        self._free: List[int] = list(range(num_slots))
+        self._next_id = 0
+        self.completed: List[ServeHandle] = []
+        self.failed: List[ServeHandle] = []
+        self.preemption_count = 0
+        self._ticks = 0
+        self._clock = 0   # arena tick counter: the phase-aligned write column
+        self._occupied_slot_ticks = 0
+        self._decoded_tokens = 0
+
+    # --- submission --------------------------------------------------------
+
+    def submit(self, text, *, slo: str = THROUGHPUT,
+               temperature: float = 1.0,
+               key: Optional[np.ndarray] = None) -> ServeHandle:
+        """Queue one request (thread-safe).  ``text`` is [text_seq_len] or
+        [1, text_seq_len] int32 tokens; ``key`` overrides the per-request
+        rng key (default: derived from (server seed, request id), so every
+        request owns an independent deterministic stream)."""
+        if slo not in SLO_CLASSES:
+            raise ValueError(f"unknown SLO class {slo!r}; one of {SLO_CLASSES}")
+        text = np.asarray(text, np.int32)
+        if text.ndim == 1:
+            text = text[None]
+        assert text.shape[0] == 1, (
+            f"one prompt per request; got batch {text.shape[0]}")
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            handle = ServeHandle(
+                request_id=rid, slo=slo, temperature=float(temperature),
+                text=text,
+                key=(np.asarray(key, np.uint32) if key is not None
+                     else np.asarray([self._seed, rid], np.uint32)),
+                submitted_at=self._time())
+            self._queues[slo].append(handle)
+        return handle
+
+    # --- scheduler iteration ----------------------------------------------
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return bool(self._running) or any(self._queues.values())
+
+    def step(self, tick: bool = True) -> int:
+        """One scheduler iteration: retire, admit, and (unless
+        ``tick=False`` — the warm-the-batch move tests use) one decode
+        tick.  Returns the number of slots that advanced."""
+        self._retire_finished()
+        self._admit_pending()
+        if not tick:
+            return 0
+        return self._tick_once()
+
+    def run_until_idle(self, max_ticks: Optional[int] = None) -> None:
+        """Drive until every queued/running request finishes (or fails)."""
+        ticks = 0
+        while self.busy:
+            advanced = self.step()
+            ticks += 1
+            if advanced == 0 and not self.busy:
+                break
+            if max_ticks is not None and ticks > max_ticks:
+                raise RuntimeError(
+                    f"server not idle after {max_ticks} ticks: "
+                    f"{len(self._running)} running, "
+                    f"{sum(map(len, self._queues.values()))} queued")
+
+    def drive(self, arrivals: Sequence[Tuple[float, dict]],
+              max_ticks: Optional[int] = None) -> dict:
+        """Open-loop trace: ``arrivals`` is [(offset_seconds, submit_kwargs)]
+        relative to the call.  Requests are submitted when the clock passes
+        their offset — never gated on service progress (open loop: the
+        queue grows if the server can't keep up, exactly like production
+        ingress).  Returns :meth:`stats` over the drive window."""
+        t0 = self._time()
+        pending = sorted(arrivals, key=lambda a: a[0])
+        i = 0
+        ticks = 0
+        tokens0 = self._decoded_tokens
+        while i < len(pending) or self.busy:
+            now = self._time() - t0
+            while i < len(pending) and pending[i][0] <= now:
+                self.submit(**pending[i][1])
+                i += 1
+            if not self.busy:
+                # idle gap before the next arrival: jump the open loop
+                # forward instead of busy-waiting on the clock
+                time.sleep(min(0.001, max(0.0, pending[i][0] - now)))
+                continue
+            self.step()
+            ticks += 1
+            if max_ticks is not None and ticks > max_ticks:
+                raise RuntimeError(f"drive exceeded {max_ticks} ticks")
+        dt = self._time() - t0
+        return self.stats(window_seconds=dt,
+                          window_tokens=self._decoded_tokens - tokens0)
+
+    # --- internals ---------------------------------------------------------
+
+    def _retire_finished(self) -> None:
+        total = self.arena.geometry.image_seq_len
+        for slot in sorted(self._running):
+            run = self._running[slot]
+            if run.done >= total:
+                codes = self.arena.fetch_codes(slot)
+                run.handle.finished_at = self._time()
+                del self._running[slot]
+                self._free.append(slot)
+                self.completed.append(run.handle)
+                run.handle.future.set_result(codes)
+
+    def _fail(self, slot: int, exc: BaseException) -> None:
+        run = self._running.pop(slot)
+        self._free.append(slot)
+        run.handle.finished_at = self._time()
+        self.failed.append(run.handle)
+        run.handle.future.set_exception(exc)
+
+    def _preempt_one_throughput(self) -> Optional[int]:
+        """Reclaim the least-progressed throughput-class slot for a
+        waiting latency request; its request restarts from prefill at the
+        front of the throughput queue.  None when nothing is preemptible
+        (every running request is latency-class)."""
+        victims = [(run.done, slot) for slot, run in self._running.items()
+                   if run.handle.slo == THROUGHPUT]
+        if not victims:
+            return None
+        _, slot = min(victims)
+        run = self._running.pop(slot)
+        self._free.append(slot)
+        run.handle.preemptions += 1
+        self.preemption_count += 1
+        with self._lock:
+            self._queues[THROUGHPUT].appendleft(run.handle)
+        return slot
+
+    def _admit_pending(self) -> None:
+        while True:
+            with self._lock:
+                want_latency = bool(self._queues[LATENCY])
+            if want_latency and not self._free:
+                if self._preempt_one_throughput() is None:
+                    break  # all slots latency-class: no preemption
+            if not self._free:
+                break
+            with self._lock:
+                for slo in SLO_CLASSES:  # latency first
+                    if self._queues[slo]:
+                        handle = self._queues[slo].popleft()
+                        break
+                else:
+                    break
+            self._admit(handle)
+
+    def _admit(self, handle: ServeHandle) -> None:
+        first_logits, caches = self.arena.prefill(
+            jnp.asarray(handle.text))
+        slot = self._free.pop()
+        # self._clock is the NEXT tick's number — it pins the slot's cache
+        # rotation so every later tick writes the shared physical column
+        self.arena.admit(slot, first_logits, caches, handle.key,
+                         handle.temperature, self._clock)
+        handle.admitted_at = self._time()
+        self._running[slot] = _Running(handle=handle, done=1)
+        self._decoded_tokens += 1  # admit samples the request's first code
+
+    def _tick_once(self) -> int:
+        # the serve_request faultpoint: one hit per occupied slot per tick,
+        # in slot order — an injected failure frees ITS slot and leaves
+        # co-batched slots advancing this very tick
+        for slot in sorted(self._running):
+            try:
+                faults.fire("serve_request",
+                            step=self._running[slot].done)
+            except faults.InjectedFault as e:
+                self._fail(slot, e)
+        # finished-but-unretired slots (possible only if a caller skips the
+        # retire phase) must NOT advance: their output row is complete and
+        # another tick would overwrite its clamped last position
+        total = self.arena.geometry.image_seq_len
+        advancing = [s for s, run in self._running.items()
+                     if run.done < total]
+        if not advancing:
+            return 0
+        mask = np.zeros((self.num_slots,), bool)
+        for slot in advancing:
+            mask[slot] = True
+        self.arena.tick(mask, self._clock)
+        self._clock += 1
+        for slot in advancing:
+            self._running[slot].done += 1
+        n = len(advancing)
+        self._ticks += 1
+        self._occupied_slot_ticks += n
+        self._decoded_tokens += n
+        return n
+
+    # --- metrics ------------------------------------------------------------
+
+    def trace_counts(self) -> dict:
+        return self.arena.trace_counts()
+
+    def stats(self, window_seconds: Optional[float] = None,
+              window_tokens: Optional[int] = None) -> dict:
+        """The bench_serve row: aggregate throughput, occupancy, latency
+        percentiles per SLO class, preemptions, failures."""
+        lat = {slo: sorted(h.latency for h in self.completed
+                           if h.slo == slo and h.latency is not None)
+               for slo in SLO_CLASSES}
+
+        def pct(values, q):
+            return float(np.percentile(values, q)) if values else None
+
+        tokens = (window_tokens if window_tokens is not None
+                  else self._decoded_tokens)
+        return dict(
+            ticks=self._ticks,
+            decoded_tokens=tokens,
+            tok_per_s=(tokens / window_seconds
+                       if window_seconds else None),
+            occupancy=(self._occupied_slot_ticks
+                       / (self._ticks * self.num_slots)
+                       if self._ticks else 0.0),
+            completed=len(self.completed),
+            failed=len(self.failed),
+            preemptions=self.preemption_count,
+            latency_p50={slo: pct(lat[slo], 50) for slo in SLO_CLASSES},
+            latency_p99={slo: pct(lat[slo], 99) for slo in SLO_CLASSES},
+            trace_counts=self.trace_counts(),
+        )
+
+    def reset(self) -> None:
+        """Drop queues/stats for a fresh measurement over the SAME arena
+        (the jitted entry points and their compiled executables survive —
+        bench_serve re-measures without re-paying compiles).  Refuses to
+        reset a busy server."""
+        assert not self.busy, "reset() on a busy server"
+        self.completed = []
+        self.failed = []
+        self.preemption_count = 0
+        self._ticks = 0
+        self._occupied_slot_ticks = 0
+        self._decoded_tokens = 0

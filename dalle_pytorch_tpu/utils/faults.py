@@ -36,6 +36,13 @@ real on-device gradients; a large finite factor lands a genuine spike);
 the hung-step watchdog's armed window so its kill-and-relaunch path is
 rehearsed end to end.
 
+Serving site (serve/scheduler.py): ``serve_request`` is hit once per
+occupied slot per decode tick (slot order; ``step`` carries the request's
+decoded-token count, so ``at_step`` can target a progress milestone).  An
+injected failure mid-decode fails THAT request — its future carries the
+fault, its slot frees the same scheduler iteration — while co-batched
+requests keep decoding (tests/test_serve.py pins the isolation).
+
 Counters are per-site and thread-safe (dataset reads run under the
 prefetching DataLoader's thread pool).  The registry is parsed lazily from
 the environment; trainers call :func:`install_from_env` at startup so

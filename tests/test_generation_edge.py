@@ -1,0 +1,143 @@
+"""Edge cases of the generation primitives the serving layer leans on
+(ISSUE 6 satellite): `tile_prefill` at reps=1, `decode_codes` resuming
+from a partially-filled cache (primed prefill), and uneven final chunks in
+`cli.iter_generated_chunks` on both the shared-prefill and
+distinct-prompt paths."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu import DALLE, DALLEConfig, VAEConfig
+from dalle_pytorch_tpu.cli import iter_generated_chunks
+from dalle_pytorch_tpu.models.dalle import (decode_codes, generate_codes,
+                                            prefill_codes, tile_prefill)
+
+VCFG = VAEConfig(image_size=16, num_tokens=32, codebook_dim=16, num_layers=2,
+                 hidden_dim=8)
+
+
+@pytest.fixture(scope="module")
+def small():
+    cfg = DALLEConfig.from_vae(
+        VCFG, dim=32, num_text_tokens=50, text_seq_len=6, depth=2, heads=2,
+        dim_head=8, attn_types=("full", "axial_row"))
+    dalle = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (1, cfg.text_seq_len), 1, 50)
+    codes = jax.random.randint(rng, (1, cfg.image_seq_len), 0, 32)
+    params = dalle.init(rng, text, codes, return_loss=True)
+    return cfg, dalle, params, text
+
+
+def test_tile_prefill_reps_1_is_identity(small):
+    """reps=1 must be an exact no-op broadcast: same shapes, same bytes,
+    and the decode it seeds matches the untiled state bit-for-bit."""
+    cfg, dalle, params, text = small
+    first, caches = prefill_codes(dalle, params, text)
+    t_first, t_caches = tile_prefill(first, caches, 1)
+    assert t_first.shape == first.shape
+    np.testing.assert_array_equal(np.asarray(t_first), np.asarray(first))
+    for (k, v), (tk, tv) in zip(caches, t_caches):
+        assert tk.shape == k.shape and tv.shape == v.shape
+        np.testing.assert_array_equal(np.asarray(tk), np.asarray(k))
+    rng = jax.random.PRNGKey(3)
+    out = decode_codes(dalle, params, first, caches, rng)
+    t_out = decode_codes(dalle, params, t_first, t_caches, rng)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(t_out))
+
+
+def test_tile_prefill_rejects_multi_prompt_batch(small):
+    cfg, dalle, params, text = small
+    first, caches = prefill_codes(
+        dalle, params, jnp.concatenate([text, text], axis=0))
+    with pytest.raises(AssertionError, match="batch-1"):
+        tile_prefill(first, caches, 4)
+
+
+def test_decode_resumes_from_partially_filled_cache(small):
+    """Greedy decoding from a primed prefill (cache already holding m image
+    codes) must continue EXACTLY where full-sequence greedy decoding would
+    — the primed cache is a mid-stream snapshot of the same computation."""
+    cfg, dalle, params, text = small
+    # full greedy generation (filter_thres=1.0 -> top-1: rng-free path)
+    full = np.asarray(generate_codes(dalle, params, text,
+                                     jax.random.PRNGKey(0),
+                                     filter_thres=1.0))
+    m = cfg.image_seq_len // 2
+    prime = jnp.asarray(full[:, :m])
+    first, caches = prefill_codes(dalle, params, text, prime_codes=prime)
+    resumed = np.asarray(decode_codes(
+        dalle, params, first, caches, jax.random.PRNGKey(9),
+        n_prime=m, prime_codes=prime, filter_thres=1.0))
+    assert resumed.shape == full.shape
+    np.testing.assert_array_equal(resumed, full)
+
+
+def test_decode_resume_prime_lengths(small):
+    """Every prime length (including the m = image_seq_len - 1 single-step
+    tail) produces a full-length, range-valid code sequence with the prime
+    preserved verbatim."""
+    cfg, dalle, params, text = small
+    rng = jax.random.PRNGKey(1)
+    base = np.asarray(generate_codes(dalle, params, text, rng,
+                                     filter_thres=1.0))
+    for m in (1, cfg.image_seq_len - 1):
+        prime = jnp.asarray(base[:, :m])
+        first, caches = prefill_codes(dalle, params, text,
+                                      prime_codes=prime)
+        out = np.asarray(decode_codes(
+            dalle, params, first, caches, rng, n_prime=m,
+            prime_codes=prime, filter_thres=1.0))
+        assert out.shape == (1, cfg.image_seq_len)
+        np.testing.assert_array_equal(out[:, :m], base[:, :m])
+        np.testing.assert_array_equal(out, base)  # greedy: tail matches too
+
+
+@pytest.mark.parametrize("shared", [True, False])
+def test_iter_generated_chunks_uneven_final_chunk(small, shared):
+    """n=5 over batch_size=2: three chunks with n_valid 2/2/1.  The shared
+    path yields full-batch chunks with the tail marked short; the distinct
+    path pads the last chunk and reports the same validity."""
+    cfg, dalle, params, text = small
+    if shared:
+        tokens = np.repeat(np.asarray(text), 5, axis=0)
+    else:
+        tokens = np.stack([np.asarray(text[0]) + i for i in range(5)]) % 50
+        tokens[tokens == 0] = 1  # keep ids in the real-token range
+    chunks, _ = iter_generated_chunks(
+        dalle, params["params"], tokens, batch_size=2, top_k=0.9,
+        rng=jax.random.PRNGKey(0))
+    seen = []
+    for codes, n_valid in chunks:
+        assert codes.shape == (2, cfg.image_seq_len)
+        assert np.asarray(codes).min() >= 0
+        assert np.asarray(codes).max() < cfg.num_image_tokens
+        seen.append(n_valid)
+    assert seen == [2, 2, 1]
+
+
+def test_iter_generated_chunks_short_request_compiles_naturally(small):
+    """n < batch_size: the chunker clamps to the natural size (one chunk,
+    no padding waste) on both paths."""
+    cfg, dalle, params, text = small
+    for tokens in (np.repeat(np.asarray(text), 3, axis=0),
+                   np.stack([np.asarray(text[0]),
+                             np.roll(np.asarray(text[0]), 1),
+                             np.roll(np.asarray(text[0]), 2)])):
+        chunks, _ = iter_generated_chunks(
+            dalle, params["params"], tokens, batch_size=16, top_k=0.9,
+            rng=jax.random.PRNGKey(0))
+        out = list(chunks)
+        assert len(out) == 1
+        codes, n_valid = out[0]
+        assert codes.shape == (3, cfg.image_seq_len)
+        assert n_valid == 3
+
+
+def test_iter_generated_chunks_empty_input(small):
+    cfg, dalle, params, _ = small
+    chunks, rng = iter_generated_chunks(
+        dalle, params["params"], np.zeros((0, cfg.text_seq_len), np.int32),
+        batch_size=4, top_k=0.9, rng=jax.random.PRNGKey(0))
+    assert list(chunks) == []

@@ -225,6 +225,58 @@ def test_s3_stable_step_traces_once():
     assert spmd.count_traces(jitted, make_args, steps=4) == 1
 
 
+# --- S3 (serve): the continuous-batching tick -----------------------------
+
+
+def test_s3_shape_changing_serve_tick_caught():
+    """The occupancy-cropped tick recompiles per admit/retire — the storm
+    the serve arena's fixed shapes exist to prevent."""
+    jitted, make_args = fx.make_shape_changing_serve_tick()
+    with pytest.raises(spmd.SPMDViolation, match="traces"):
+        spmd.check_single_trace(jitted, make_args, steps=4,
+                                label="serve-fixture")
+
+
+def test_s3_serve_harness_clean_on_real_arena(cli):
+    """The CLI's serve-tick harness: real GenerationServer, admit/retire
+    churn across occupancies + a clock wrap, one executable per entry
+    point."""
+    detail = cli.serve_retrace_check()
+    assert "compiled once" in detail
+
+
+# --- S4 opt0-drift gate (scheduled CI) ------------------------------------
+
+
+def test_s4_drift_gate_clean_at_tiny_geometry(cli):
+    detail = cli.s4_drift_check(make_cfg=cli.tiny_config)
+    assert "opt0 == full-opt" in detail
+
+
+def test_s4_drift_gate_catches_divergence(cli, monkeypatch):
+    """A synthetic opt0/full-opt disagreement (the XLA-upgrade failure
+    mode the scheduled job watches for) must raise."""
+    import dataclasses as dc
+
+    estimates = iter([
+        spmd.HBMEstimate(argument_bytes=100, output_bytes=50,
+                         alias_bytes=0, temp_bytes=1000),       # full-opt
+        spmd.HBMEstimate(argument_bytes=100, output_bytes=50,
+                         alias_bytes=0, temp_bytes=400),        # opt0
+    ])
+    monkeypatch.setattr(cli.spmd, "hbm_estimate",
+                        lambda compiled: next(estimates))
+
+    class _FakeLowered:
+        def compile(self, *a, **k):
+            return object()
+
+    monkeypatch.setattr(cli, "dalle_step_lowered",
+                        lambda *a, **k: _FakeLowered())
+    with pytest.raises(spmd.SPMDViolation, match="temp_bytes"):
+        cli.s4_drift_check()
+
+
 # --- S4: static HBM budget ------------------------------------------------
 
 

@@ -29,9 +29,9 @@ cd "$(dirname "$0")/.."
 # re-armed queue whose stage COMMANDS changed can never be skipped by a
 # stale marker from an older queue definition — bump QV whenever any
 # stage's command line changes.
-QV=9
+QV=10
 
-STAGES="gen_bf16_ab gen_fused_ab ab_cand bench gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap"
+STAGES="gen_bf16_ab gen_fused_ab ab_cand bench gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap bench_serve"
 
 # Overridable knobs so tests/test_babysitter.py can drive the REAL script
 # (fake python on PATH, private marker dir, second-scale sleeps) without
@@ -252,4 +252,10 @@ run_stage loss_tpu  2400 python tools/loss_curve.py --captions real \
 run_stage ab_ptiles 1500 python tools/perf_ab.py pallas pallas-b256 pallas-b512 --reps 2
 run_stage ab_batch  1500 python tools/perf_ab.py baseline batch64 batch128 --reps 2
 run_stage ab_fmap   1800 python tools/perf_ab.py fmap64 fmap64-pallas --reps 2
+# continuous-batching serve vs gen64's static-batch headline: aggregate
+# tok/s across interleaved open-loop requests at 64 slots + p50/p99 per
+# request (ISSUE 6; behind the queued A/Bs — it shares their chip budget
+# but decides no pending config flip).  The serve-tick no-retrace
+# property is pre-gated chip-free by spmd_check's serve harness above.
+run_stage bench_serve 2400 python tools/perf_ab.py serve64 gen64 --reps 2
 echo "$(date +%T) all chip work finished"

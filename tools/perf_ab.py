@@ -85,8 +85,13 @@ VARIANTS = {
 # gen_fused_rank: the fused generate→VAE-decode→CLIP-rerank pipeline
 # (genrank.rank_codes, shared prefill, zero disk round-trips), in
 # images-ranked/sec.
+# serve64 / serve16: the continuous-batching generation service
+# (serve.GenerationServer: slot KV arena, per-tick admission, open-loop
+# arrival trace at 1.25x oversubscription) — aggregate tok/s across
+# INTERLEAVED requests; serve64 is the direct A/B against gen64's
+# static-batch 35.2k tok/s headline.
 EXTRAS = ("gen", "gen64", "vae", "gen-dense", "gen_bf16", "gen_f32cache",
-          "gen_fused_rank")
+          "gen_fused_rank", "serve64", "serve16")
 
 
 def main(argv=None) -> int:
@@ -146,6 +151,9 @@ def main(argv=None) -> int:
                 kv_cache_bf16=(name == "gen_bf16"))
         elif name == "gen_fused_rank":
             measures[name] = bench.make_fused_rank_measure(batch=8)
+        elif name in ("serve64", "serve16"):
+            measures[name] = bench.make_serve_measure(
+                num_slots=64 if name == "serve64" else 16)
         elif name == "vae":
             measures[name] = bench.make_vae_measure()
         else:
@@ -155,7 +163,9 @@ def main(argv=None) -> int:
     def unit(name):
         if name == "gen_fused_rank":  # rank_codes reports whole images
             return "img/s"
-        return "tok/s" if name.startswith("gen") else "img/s"
+        if name.startswith(("gen", "serve")):
+            return "tok/s"
+        return "img/s"
 
     results = {name: [] for name in measures}
     for rep in range(args.reps):

@@ -333,6 +333,89 @@ def decode_jaxpr(make_cfg=tiny_config, batch: int = 2):
     return jax.make_jaxpr(run)(variables, logits, kvs, rng)
 
 
+def serve_retrace_check(num_slots: int = 3):
+    """S3 for the continuous-batching serve tick (ISSUE 6): drive a real
+    GenerationServer over the tiny model through admit/retire churn —
+    occupancy rising 1 -> num_slots mid-flight, requests retiring at
+    staggered ticks, a freed slot re-admitted, the arena clock wrapping
+    seq_len — and require every jitted entry point (prefill / admit /
+    tick) to have compiled EXACTLY once.  A per-occupancy or per-slot
+    shape anywhere in the arena turns every arrival into a recompile on
+    the pod (the storm `lint/spmd_fixtures.py::
+    make_shape_changing_serve_tick` exhibits, proven caught in the
+    selftest)."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.serve import GenerationServer
+
+    cfg = tiny_config()
+    dalle = DALLE(cfg)
+    text = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
+    codes = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
+    variables = dalle.init(jax.random.PRNGKey(0), text, codes)
+    server = GenerationServer(dalle, variables, num_slots=num_slots,
+                              filter_thres=0.9)
+
+    def prompt(i):
+        r = np.random.RandomState(i)
+        return r.randint(1, 40, size=(cfg.text_seq_len,)).astype(np.int32)
+
+    server.submit(prompt(0))
+    for _ in range(3):                      # occupancy 1
+        server.step()
+    for i in range(1, num_slots):
+        server.submit(prompt(i))            # fill mid-flight
+    for _ in range(3):                      # occupancy num_slots
+        server.step()
+    server.submit(prompt(num_slots))        # queued; admits on first retire
+    server.run_until_idle(max_ticks=40 * cfg.image_seq_len)
+    assert server._clock > cfg.seq_len, "churn must wrap the arena clock"
+    counts = server.trace_counts()
+    bad = {k: v for k, v in counts.items() if v != 1}
+    if bad:
+        raise spmd.SPMDViolation(
+            f"S3 retrace [serve-tick]: admit/retire churn across "
+            f"occupancies 1..{num_slots} recompiled {bad} — a serve-path "
+            "shape depends on occupancy/slot/clock; every arrival would "
+            "recompile on the pod")
+    return (f"{len(server.completed)} requests across occupancies "
+            f"1..{num_slots}, clock wrapped at {server._clock} ticks: "
+            "prefill/admit/tick each compiled once")
+
+
+def s4_drift_check(plan: str = "dp", make_cfg=cub_config,
+                   temp_tol: float = 0.15) -> str:
+    """S4 opt-0 drift gate (PR 5 carried follow-up): S4 budgets every plan
+    from a backend-opt-level-0 compile on the assumption that XLA's
+    argument/output/temp buffer assignment is identical to the full
+    pipeline's.  That held when measured, but nothing pins it across XLA
+    upgrades — so compile ONE plan BOTH ways and diff: argument and
+    output bytes must match exactly, temp bytes within ``temp_tol``.
+    Scheduled CI runs this (tests.yml full job); a failure means the
+    opt-0 shortcut now under- or over-budgets and S4 must recalibrate."""
+    lowered = dalle_step_lowered(plan, make_cfg=make_cfg)
+    with spmd.fresh_stats_compile():
+        full = spmd.hbm_estimate(lowered.compile())
+        opt0 = spmd.hbm_estimate(lowered.compile(OPT0))
+    problems = []
+    for field in ("argument_bytes", "output_bytes"):
+        a, b = getattr(full, field), getattr(opt0, field)
+        if a != b:
+            problems.append(f"{field}: full-opt {a} != opt0 {b}")
+    drift = abs(opt0.temp_bytes - full.temp_bytes) / max(full.temp_bytes, 1)
+    if drift > temp_tol:
+        problems.append(
+            f"temp_bytes: full-opt {full.temp_bytes} vs opt0 "
+            f"{opt0.temp_bytes} ({drift:.1%} > {temp_tol:.0%})")
+    if problems:
+        raise spmd.SPMDViolation(
+            f"S4 opt0-drift [dalle/{plan}]: " + "; ".join(problems) +
+            " — XLA's opt-0 buffer assignment no longer matches the full "
+            "pipeline; the S4 budget shortcut is invalid")
+    return (f"opt0 == full-opt: args {full.argument_bytes}, out "
+            f"{full.output_bytes}, temp drift {drift:.1%}")
+
+
 def check_factory_coverage() -> None:
     """The registry/harness sync gate: every training.STEP_FACTORIES entry
     has a harness here, and vice versa."""
@@ -397,6 +480,10 @@ def run_all(chip: str = "v4-8", quick: bool = False,
     run("S1-collectives", "decode",
         lambda: "; ".join(x.format() for x in spmd.check_collective_order(
             decode_jaxpr(), label="decode")) or "no collectives")
+    # the continuous-batching serve tick: admit/retire churn across
+    # occupancies must reuse ONE executable per entry point (ISSUE 6
+    # acceptance gate, chip-free twin of tests/test_serve.py)
+    run("S3-retrace", "serve-tick", serve_retrace_check)
 
     # S2 per plan at tiny geometry, FULL-opt compile (donation honoring
     # is structural — layout/sharding mismatches reproduce at any size —
@@ -514,6 +601,11 @@ def selftest() -> int:
         *fx.make_unhashable_static_step()))
     spmd.check_single_trace(*fx.make_stable_step())
     print("PASS S3 stable twin: clean")
+    expect_catch(
+        "S3 occupancy-shaped serve tick",
+        lambda: spmd.check_single_trace(
+            *fx.make_shape_changing_serve_tick(), steps=4,
+            label="serve-fixture"))
 
     est = spmd.hbm_estimate(fx.oversized_step_compiled())
     toy = dict(spmd.CHIP_HBM_BYTES, toy=1 << 20)
@@ -546,9 +638,24 @@ def main(argv=None) -> int:
     parser.add_argument("--selftest", action="store_true",
                         help="prove each analysis catches its deliberately-"
                              "broken fixture, then exit")
+    parser.add_argument("--s4-drift", action="store_true",
+                        help="compile ONE plan at opt-0 AND full "
+                             "optimization and diff arg/out/temp sizes — "
+                             "the scheduled-CI gate that keeps the S4 "
+                             "opt-0 shortcut honest across XLA upgrades "
+                             "(--quick drops to tiny geometry)")
     args = parser.parse_args(argv)
     if args.selftest:
         return selftest()
+    if args.s4_drift:
+        try:
+            detail = s4_drift_check(
+                make_cfg=tiny_config if args.quick else cub_config)
+        except spmd.SPMDViolation as e:
+            print(f"FAIL S4-drift: {e}")
+            return 1
+        print(f"PASS S4-drift [dalle/dp]: {detail}")
+        return 0
     return run_all(chip=args.chip, quick=args.quick, json_out=args.json)
 
 
