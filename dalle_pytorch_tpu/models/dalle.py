@@ -107,13 +107,41 @@ class DALLEConfig:
     # k/v values round through bf16.  False is the A/B control
     # (tools/perf_ab.py `gen_f32cache`).  No-op when dtype is already bf16.
     kv_cache_bf16: bool = True
+    # Int8 cache storage (takes precedence over kv_cache_bf16): the caches
+    # become (int8 values, f32 per-head scale) pairs — ops/quant.py layout
+    # — halving the dominant decode byte stream AGAIN over bf16.  Scales
+    # are computed once at prefill write time (per slot in the serve
+    # arena); decode writes saturate under the frozen scale; every dot
+    # keeps the int8 tensor as a multiplicand with f32 accumulation
+    # (contract_check C2/C3 pin the no-dequant-hoist property).  OFF by
+    # default until the queued `gen_int8_ab` wall-clock A/B lands.
+    kv_cache_int8: bool = False
+    # Int8 decode-path weights: attn/ff projection kernels + the image-
+    # phase logits head are quantized ONCE per generate/serve session to
+    # int8 with per-output-channel f32 scales (quantize_decode_weights)
+    # and the decode program consumes ONLY the quantized copies (jit
+    # prunes the unused f32 originals from its arguments) — halving the
+    # weight stream that dominates small-batch decode.  Training, prefill
+    # and the forward pass are untouched.
+    weights_int8: bool = False
+    # Serve-path sliced reads through the cache rotation as circular
+    # dynamic_slice spans (<=2 per row) instead of a per-key gather —
+    # bit-identical (ops/attention.py::_decode_step_aligned); False is
+    # the A/B control.
+    aligned_span_decode: bool = True
     dtype: Any = jnp.float32
 
     # execution-plan fields stripped from checkpoint hparams (like dtype):
     # they select how the same params are computed, not what the model is
     _PLAN_FIELDS = ("ring_axis", "sp_impl", "sp_size",
                     "ff_expert_dispatch", "ff_expert_capacity_factor",
-                    "head_phase_sliced", "sliced_kv_decode", "kv_cache_bf16")
+                    "head_phase_sliced", "sliced_kv_decode", "kv_cache_bf16",
+                    "kv_cache_int8", "weights_int8", "aligned_span_decode")
+
+    def __post_init__(self):
+        assert not (self.weights_int8 and self.ff_experts > 1), (
+            "weights_int8 quantizes the dense GEGLU kernels; MoE expert "
+            "kernels are not supported on the quantized decode path")
 
     @property
     def image_seq_len(self) -> int:
@@ -271,6 +299,7 @@ def transformer_kwargs(cfg: DALLEConfig) -> dict:
         pallas_block_k=cfg.pallas_block_k,
         ring_axis=cfg.ring_axis, sp_impl=cfg.sp_impl,
         sliced_kv_decode=cfg.sliced_kv_decode,
+        aligned_span_decode=cfg.aligned_span_decode,
         ff_experts=cfg.ff_experts, ff_expert_top_k=cfg.ff_expert_top_k,
         ff_expert_dispatch=cfg.ff_expert_dispatch,
         ff_expert_capacity_factor=cfg.ff_expert_capacity_factor,
@@ -380,11 +409,20 @@ class DALLE(nn.Module):
             tokens = tokens[:, : cfg.seq_len]
         return tokens
 
-    def _head(self, out, image_only: bool = False, text_only: bool = False):
+    def _head(self, out, image_only: bool = False, text_only: bool = False,
+              qhead=None):
         """final-norm (f32) + logits head — shared by the dense loss, the
-        sp loss, the inference forward and the prefill/decode paths."""
-        return self.to_logits_dense(self.final_norm(out.astype(jnp.float32)),
-                                    image_only=image_only,
+        sp loss, the inference forward and the prefill/decode paths.
+        ``qhead`` (decode only, ``weights_int8``) is the session-quantized
+        image-phase kernel ``(int8, scale, bias)``: the head matmul then
+        runs the int8 kernel as a direct multiplicand (f32 accumulation),
+        bypassing — and letting jit prune — the f32 PhaseLogits params."""
+        h = self.final_norm(out.astype(jnp.float32))
+        if qhead is not None:
+            assert image_only, "quantized head is the decode (image) phase"
+            from ..ops.quant import qdense
+            return qdense(h, *qhead)  # f32 logits
+        return self.to_logits_dense(h, image_only=image_only,
                                     text_only=text_only)
 
     @staticmethod
@@ -518,7 +556,15 @@ class DALLE(nn.Module):
 
         out, kvs = self.transformer(tokens, mask=self._pad_mask_for_bos(mask),
                                     return_kv=True)
-        if cfg.kv_cache_bf16:
+        if cfg.kv_cache_int8:
+            # int8 cache storage: per-head symmetric scales computed HERE,
+            # at prefill write time — the one place the whole sequence is
+            # in hand — then frozen for the decode writes (ops/quant.py
+            # scale-layout contract).  Takes precedence over kv_cache_bf16.
+            from ..ops.quant import quantize_per_head
+            kvs = [(quantize_per_head(k), quantize_per_head(v))
+                   for k, v in kvs]
+        elif cfg.kv_cache_bf16:
             # cache STORAGE dtype only: the decode step re-reads these
             # through f32-accumulating dots (ops/attention.py::decode_step),
             # so this is a pure byte cut on the HBM-bound decode loop
@@ -528,7 +574,8 @@ class DALLE(nn.Module):
         logits = self._head(last, image_only=True)
         return logits[:, 0], kvs
 
-    def decode_step(self, code, caches, index, mask=None, write_pos=None):
+    def decode_step(self, code, caches, index, mask=None, write_pos=None,
+                    qweights=None):
         """One sampled image code in, next-position logits out.
 
         `code` [b] is the image-vocab token at *input* position `index`
@@ -539,7 +586,12 @@ class DALLE(nn.Module):
         With ``write_pos`` (the serving arena's phase-aligned mode, see
         ops/attention.py), ``index`` may be a per-row [b] vector — every
         row decodes at its own depth against rotated caches that all
-        write the same physical column."""
+        write the same physical column.
+
+        ``qweights`` (``weights_int8``) is the session-quantized weight
+        tree from :func:`quantize_decode_weights`; the attention/FF
+        projections and the image head then run int8 multiplicands with
+        f32 accumulation instead of streaming the f32 params."""
         cfg = self.cfg
         emb = self.image_emb(code[:, None])
         img_index = index - (cfg.text_seq_len + 1)
@@ -555,9 +607,54 @@ class DALLE(nn.Module):
         x = emb.astype(cfg.dtype)
         out, caches = self.transformer.decode_step(
             x, caches, index, mask=self._pad_mask_for_bos(mask),
-            write_pos=write_pos)
-        logits = self._head(out, image_only=True)
+            write_pos=write_pos,
+            qweights=None if qweights is None else qweights["layers"])
+        logits = self._head(out, image_only=True,
+                            qhead=None if qweights is None
+                            else qweights["head"])
         return logits[:, 0], caches
+
+
+def quantize_decode_weights(params, cfg: DALLEConfig):
+    """One-shot int8 quantization of every decode-path weight matrix —
+    the ``weights_int8`` half of the quantized-serving recipe.
+
+    Run ONCE per generate/serve session (the serve arena does it at
+    construction; ``decode_codes`` does it per jitted call, where XLA
+    hoists it out of the decode scan): returns the quantized-weight tree
+    ``DALLE.decode_step`` consumes — per layer ``{"qkv": (int8 [dim, 3,
+    h, dh], f32 scale), "out"/"ff_in"/"ff_out": (int8, scale, f32
+    bias)}`` plus ``"head"`` for the image-phase logits kernel.  Scales
+    are per-output-channel (ops/quant.py::quantize_weight, reduced over
+    the input dim), so every output column keeps its own dynamic range —
+    the LLM.int8() weight layout.  The f32 originals stay in ``params``
+    untouched (checkpoints, training and prefill never see int8); the
+    compiled decode/tick programs simply stop referencing them, so jit's
+    unused-argument pruning removes them from the weight stream."""
+    from ..ops.quant import quantize_weight
+
+    assert cfg.ff_experts <= 1, (
+        "weights_int8 does not cover MoE expert kernels")
+    if "params" in params:  # accept the full variables dict too
+        params = params["params"]
+    t = params["transformer"]
+    layers = []
+    for i in range(cfg.depth):
+        attn = t[f"layers_{i}_attn"]["attn"]
+        ff = t[f"layers_{i}_ff"]
+        layers.append({
+            "qkv": quantize_weight(attn["to_qkv"]["kernel"]),
+            "out": (*quantize_weight(attn["to_out"]["kernel"]),
+                    attn["to_out"]["bias"]),
+            "ff_in": (*quantize_weight(ff["dense_in"]["kernel"]),
+                      ff["dense_in"]["bias"]),
+            "ff_out": (*quantize_weight(ff["dense_out"]["kernel"]),
+                       ff["dense_out"]["bias"]),
+        })
+    head = params["to_logits_dense"]
+    return {"layers": layers,
+            "head": (*quantize_weight(head["image_kernel"]),
+                     head["image_bias"])}
 
 
 def sample_image_code(logits, key, *, k_vocab: int,
@@ -610,7 +707,9 @@ def tile_prefill(first_logits, caches, reps: int):
         "tile_prefill broadcasts a single-prompt (batch-1) prefill; got "
         f"batch {first_logits.shape[0]}")
     rep = lambda a: jnp.repeat(a, reps, axis=0)  # noqa: E731
-    return rep(first_logits), [(rep(k), rep(v)) for k, v in caches]
+    # tree_map, not tuple unpacking: int8 cache entries are (values,
+    # scale) pairs and the per-head scale planes tile on the same axis
+    return rep(first_logits), jax.tree.map(rep, caches)
 
 
 def decode_codes(dalle: DALLE, params, first_logits, caches, rng, *,
@@ -627,6 +726,10 @@ def decode_codes(dalle: DALLE, params, first_logits, caches, rng, *,
     """
     cfg = dalle.cfg
     n_pre = cfg.text_seq_len + 1 + n_prime
+    # weights_int8: quantize once per call — a scan constant, so XLA
+    # hoists it and the decode loop streams only the int8 copies
+    qweights = (quantize_decode_weights(params, cfg)
+                if cfg.weights_int8 else None)
 
     def sample(logits, key):
         return sample_image_code(logits, key, k_vocab=cfg.total_tokens,
@@ -639,7 +742,8 @@ def decode_codes(dalle: DALLE, params, first_logits, caches, rng, *,
     def step(carry, key):
         code, caches, index = carry
         logits, caches = dalle.apply(
-            params, code, caches, index, mask, method=DALLE.decode_step)
+            params, code, caches, index, mask, None, qweights,
+            method=DALLE.decode_step)
         next_code = sample(logits, key)
         return (next_code, caches, index + 1), next_code
 

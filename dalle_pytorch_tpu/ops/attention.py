@@ -41,6 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.helpers import max_neg_value
+from .quant import (cache_write, circular_slice_in_dim, qdense, scaled_qdot,
+                    split_cache)
 
 VARIANTS = ("full", "axial_row", "axial_col", "conv_like", "sparse")
 
@@ -320,6 +322,10 @@ class MultiHeadAttention(nn.Module):
     #   (decode_key_positions); False streams the full cache — the A/B
     #   control for the sliced path, selectable per-build so the choice is
     #   part of the traced config, never a monkeypatch around the compile
+    aligned_span_decode: bool = True  # serve-path sliced reads as circular
+    #   dynamic_slice spans (<=2 per row) instead of the per-key vmapped
+    #   gather; bit-identical (same key order/masks), False is the A/B
+    #   control — again part of the traced config
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -404,13 +410,45 @@ class MultiHeadAttention(nn.Module):
             return out, (k, v)
         return out
 
+    def _qkv_decode(self, x, qw):
+        """Decode-path QKV projection: the f32/bf16 kernel, or — under
+        ``weights_int8`` — the session-quantized int8 kernel as a direct
+        dot multiplicand (ops/quant.py::qdense; per-output-channel scales
+        applied to the small product, never to the kernel)."""
+        if qw is None:
+            return self._qkv(x)
+        q8, s = qw["qkv"]                       # [dim, 3, h, dh] int8
+        qkv = qdense(x, q8, s).astype(self.dtype)
+        qkv = qkv.transpose(2, 0, 3, 1, 4)      # [3, b, heads, n, dh]
+        return qkv[0], qkv[1], qkv[2]
+
+    def _out_proj(self, out, qw):
+        if qw is None:
+            return self.to_out(out)
+        q8, s, bias = qw["out"]
+        return qdense(out, q8, s, bias).astype(self.dtype)
+
+    def _cache_dots(self, q_scaled, k_sub, k_scale):
+        """q·k over a cache read of either storage layout.  Plain caches
+        keep the calibrated form (multiplicands in the cache dtype, f32
+        accumulation); int8 caches keep the int8 tensor as the
+        multiplicand and apply the per-head scale to the f32 dots —
+        either way no full-precision cache copy ever exists for XLA to
+        hoist (contract_check C2/C3)."""
+        if k_scale is None:
+            return jnp.einsum("bhid,bhjd->bhij",
+                              q_scaled.astype(k_sub.dtype), k_sub,
+                              preferred_element_type=jnp.float32)
+        return scaled_qdot("bhid,bhjd->bhij", q_scaled, k_sub, k_scale)
+
     def decode_step(self, x, cache_k, cache_v, index, mask=None,
-                    write_pos=None):
+                    write_pos=None, qw=None):
         """Single-token decode with KV cache.
 
-        x: [b, 1, dim]; cache_k/v: [b, heads, n_cache, dim_head]; `index` is
-        the traced absolute position of this token.  Returns (out, new_k,
-        new_v).
+        x: [b, 1, dim]; cache_k/v: [b, heads, n_cache, dim_head] — or,
+        under ``kv_cache_int8``, the pair ``(values int8, scale f32
+        [b, heads, 1, 1])`` (ops/quant.py); `index` is the traced
+        absolute position of this token.  Returns (out, new_k, new_v).
 
         ``write_pos`` selects the PHASE-ALIGNED mode the serving arena
         (serve/engine.py) runs in: ``index`` may then be a per-sequence
@@ -426,17 +464,22 @@ class MultiHeadAttention(nn.Module):
         rotation by rolling the prefilled caches once).  Masks translate
         physical -> logical per row; with ``write_pos=None`` (the static
         sampler) behavior is bit-identical to before the serve work.
+
+        ``qw`` (``weights_int8``) carries this layer's session-quantized
+        projection kernels ``{"qkv": (int8, scale), "out": (int8, scale,
+        bias)}`` — models/dalle.py::quantize_decode_weights builds it
+        once per generate/serve session.
         """
         b = x.shape[0]
-        q, k, v = self._qkv(x)  # [b, h, 1, dh]
+        q, k, v = self._qkv_decode(x, qw)  # [b, h, 1, dh]
         if write_pos is not None:
             return self._decode_step_aligned(x, q, k, v, cache_k, cache_v,
-                                             index, write_pos, mask)
-        cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
-                                               (0, 0, index, 0))
-        cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
-                                               (0, 0, index, 0))
-        n_k = cache_k.shape[2]
+                                             index, write_pos, mask, qw)
+        cache_k = cache_write(cache_k, k, (0, 0, index, 0))
+        cache_v = cache_write(cache_v, v, (0, 0, index, 0))
+        k_vals, k_scale = split_cache(cache_k)
+        v_vals, v_scale = split_cache(cache_v)
+        n_k = k_vals.shape[2]
         scale = self.dim_head ** -0.5
         sliced = (decode_key_positions(self.pattern, index)
                   if self.sliced_kv_decode else None)
@@ -474,16 +517,14 @@ class MultiHeadAttention(nn.Module):
                          jax.lax.dynamic_slice_in_dim(cache, start, m_img,
                                                       axis=2)], axis=2)
 
-                k_sub, v_sub = seg(cache_k), seg(cache_v)
+                k_sub, v_sub = seg(k_vals), seg(v_vals)
                 safe = positions  # all in [0, n_k) by the clamp above
             else:
                 valid = valid & (positions >= 0) & (positions < n_k)
                 safe = jnp.clip(positions, 0, n_k - 1)
-                k_sub = jnp.take(cache_k, safe, axis=2)  # [b, h, m, dh]
-                v_sub = jnp.take(cache_v, safe, axis=2)
-            dots = jnp.einsum("bhid,bhjd->bhij",
-                              (q * scale).astype(cache_k.dtype), k_sub,
-                              preferred_element_type=jnp.float32)
+                k_sub = jnp.take(k_vals, safe, axis=2)  # [b, h, m, dh]
+                v_sub = jnp.take(v_vals, safe, axis=2)
+            dots = self._cache_dots(q * scale, k_sub, k_scale)
             row = (_allowed(self.pattern, index, positions, jnp)
                    & valid)[None, None, None, :]
             if mask is not None:
@@ -491,13 +532,11 @@ class MultiHeadAttention(nn.Module):
                 row = row & jnp.take(pad, safe, axis=1)[:, None, None, :]
             dots = jnp.where(row, dots, max_neg_value(dots.dtype))
             attn = jax.nn.softmax(dots, axis=-1)  # f32
-            out = self._attn_v(attn, v_sub, x.dtype)
+            out = self._attn_v(attn, v_sub, v_scale, x.dtype)
             out = out.transpose(0, 2, 1, 3).reshape(
                 b, 1, self.heads * self.dim_head)
-            return self.to_out(out), cache_k, cache_v
-        dots = jnp.einsum("bhid,bhjd->bhij",
-                          (q * scale).astype(cache_k.dtype), cache_k,
-                          preferred_element_type=jnp.float32)
+            return self._out_proj(out, qw), cache_k, cache_v
+        dots = self._cache_dots(q * scale, k_vals, k_scale)
         layout = self.pattern.block_layout()
         row = pattern_mask_row(
             self.pattern, index, n_k,
@@ -506,12 +545,12 @@ class MultiHeadAttention(nn.Module):
         row = _merge_key_pad_mask(self.pattern, row, mask)
         dots = jnp.where(row, dots, max_neg_value(dots.dtype))
         attn = jax.nn.softmax(dots, axis=-1)  # f32
-        out = self._attn_v(attn, cache_v, x.dtype)
+        out = self._attn_v(attn, v_vals, v_scale, x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(b, 1, self.heads * self.dim_head)
-        return self.to_out(out), cache_k, cache_v
+        return self._out_proj(out, qw), cache_k, cache_v
 
     def _decode_step_aligned(self, x, q, k, v, cache_k, cache_v, index,
-                             write_pos, mask):
+                             write_pos, mask, qw=None):
         """Phase-aligned decode (see ``decode_step``): per-row logical
         ``index`` [b] (or scalar, broadcast), one shared physical write
         column ``write_pos``.  Row caches are rotated by
@@ -519,24 +558,32 @@ class MultiHeadAttention(nn.Module):
         in physical order (sums are order-free) and masks by the LOGICAL
         position of each physical column, which also hides the previous
         resident's stale keys (they map to logical positions the causal
-        pattern can't reach).  The sliced-KV read becomes a per-row gather
-        at rotated positions — ``dynamic_slice`` can't span the circular
-        wrap."""
+        pattern can't reach).
+
+        Sliced reads through the rotation: with ``aligned_span_decode``
+        (default) each row's circular window is read as at most TWO
+        contiguous ``dynamic_slice`` spans (text prefix + image window,
+        each via ops/quant.py::circular_slice_in_dim, reassembled in
+        logical order) — bit-identical to the per-key vmapped gather (the
+        False control) because key order, values at valid lanes, and
+        masks are all equal; only the HBM access pattern differs.
+        Non-contiguous windows (axial_col, dilated conv) keep the
+        gather."""
         assert mask is None, (
             "phase-aligned decode does not take a key padding mask; serve "
             "requests carry fully-valid prompts")
         b = x.shape[0]
-        n_k = cache_k.shape[2]
+        n_k = split_cache(cache_k)[0].shape[2]
         scale = self.dim_head ** -0.5
         idx = jnp.broadcast_to(jnp.asarray(index, jnp.int32), (b,))
         r = jnp.remainder(write_pos - idx, n_k)  # [b] rotation per row
         # the ONE aligned write: every row's next token lands in the same
         # physical column, so this stays a dynamic_update_slice (in-place
         # under donation) instead of a scatter
-        cache_k = jax.lax.dynamic_update_slice(
-            cache_k, k.astype(cache_k.dtype), (0, 0, write_pos, 0))
-        cache_v = jax.lax.dynamic_update_slice(
-            cache_v, v.astype(cache_v.dtype), (0, 0, write_pos, 0))
+        cache_k = cache_write(cache_k, k, (0, 0, write_pos, 0))
+        cache_v = cache_write(cache_v, v, (0, 0, write_pos, 0))
+        k_vals, k_scale = split_cache(cache_k)
+        v_vals, v_scale = split_cache(cache_v)
 
         sliced = (decode_key_positions(self.pattern, jnp.int32(0))
                   if self.sliced_kv_decode else None)
@@ -547,24 +594,46 @@ class MultiHeadAttention(nn.Module):
             positions, valid, _ = jax.vmap(
                 lambda i: decode_key_positions(self.pattern, i))(idx)
             valid = valid & (positions >= 0) & (positions < n_k)
-            safe = jnp.clip(positions, 0, n_k - 1)
-            phys = jnp.remainder(safe + r[:, None], n_k)     # [b, m]
-            k_sub = jnp.take_along_axis(
-                cache_k, phys[:, None, :, None], axis=2)     # [b, h, m, dh]
-            v_sub = jnp.take_along_axis(
-                cache_v, phys[:, None, :, None], axis=2)
-            dots = jnp.einsum("bhid,bhjd->bhij",
-                              (q * scale).astype(cache_k.dtype), k_sub,
-                              preferred_element_type=jnp.float32)
+            T = self.pattern.text_len
+            if sliced[2] and self.aligned_span_decode:
+                # span reads: per row, the text prefix is the circular
+                # span [r, r+T) and the image window [pos[T]+r, ...+m)
+                # — two block reads instead of T+m key gathers.  Values
+                # at out-of-range lanes (the padded grid's one-position
+                # overrun) differ from the gather path's clamped reads
+                # but are masked to -inf either way, so the softmax
+                # consumes identical arrays lane-for-lane.
+                m_img = positions.shape[1] - T
+                img_start = positions[:, T] + r
+
+                def spans(cache):
+                    # the static prefixes are row-invariant: slice them
+                    # once for the whole batch, outside the per-row map
+                    text_lo = jax.lax.slice_in_dim(cache, 0, T, axis=2)
+                    img_lo = jax.lax.slice_in_dim(cache, 0, m_img, axis=2)
+                    text = jax.vmap(lambda c, s, lo: circular_slice_in_dim(
+                        c, s, T, axis=1, prefix=lo))(cache, r, text_lo)
+                    img = jax.vmap(lambda c, s, lo: circular_slice_in_dim(
+                        c, s, m_img, axis=1, prefix=lo))(cache, img_start,
+                                                         img_lo)
+                    return jnp.concatenate([text, img], axis=2)
+
+                k_sub, v_sub = spans(k_vals), spans(v_vals)
+            else:
+                safe = jnp.clip(positions, 0, n_k - 1)
+                phys = jnp.remainder(safe + r[:, None], n_k)     # [b, m]
+                k_sub = jnp.take_along_axis(
+                    k_vals, phys[:, None, :, None], axis=2)      # [b,h,m,dh]
+                v_sub = jnp.take_along_axis(
+                    v_vals, phys[:, None, :, None], axis=2)
+            dots = self._cache_dots(q * scale, k_sub, k_scale)
             row = (_allowed(self.pattern, idx[:, None], positions, jnp)
                    & valid)[:, None, None, :]
             dots = jnp.where(row, dots, max_neg_value(dots.dtype))
             attn = jax.nn.softmax(dots, axis=-1)  # f32
-            out = self._attn_v(attn, v_sub, x.dtype)
+            out = self._attn_v(attn, v_sub, v_scale, x.dtype)
         else:
-            dots = jnp.einsum("bhid,bhjd->bhij",
-                              (q * scale).astype(cache_k.dtype), cache_k,
-                              preferred_element_type=jnp.float32)
+            dots = self._cache_dots(q * scale, k_vals, k_scale)
             logical = jnp.remainder(
                 jnp.arange(n_k, dtype=jnp.int32)[None, :] - r[:, None], n_k)
             layout = self.pattern.block_layout()
@@ -574,12 +643,12 @@ class MultiHeadAttention(nn.Module):
             dots = jnp.where(row[:, None, None, :], dots,
                              max_neg_value(dots.dtype))
             attn = jax.nn.softmax(dots, axis=-1)  # f32
-            out = self._attn_v(attn, cache_v, x.dtype)
+            out = self._attn_v(attn, v_vals, v_scale, x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(b, 1, self.heads * self.dim_head)
-        return self.to_out(out), cache_k, cache_v
+        return self._out_proj(out, qw), cache_k, cache_v
 
     @staticmethod
-    def _attn_v(attn, v, out_dtype):
+    def _attn_v(attn, v, v_scale, out_dtype):
         """Decode-step attn (f32) x cached-v contraction.
 
         When the cache dtype differs from the activation dtype (the
@@ -590,8 +659,14 @@ class MultiHeadAttention(nn.Module):
         the convert through the cache update and materialize a full f32
         copy of the bf16 cache (measured: it more than doubles the decode
         step's cache bytes, defeating DALLEConfig.kv_cache_bf16 entirely).
-        When the dtypes already match, the contraction keeps the exact
-        form the decode-byte gates are calibrated against."""
+        Int8 caches (``v_scale`` present) follow the same discipline one
+        level down: the int8 values are the multiplicand, the per-head
+        scale multiplies the small f32 product.  When the dtypes already
+        match, the contraction keeps the exact form the decode-byte gates
+        are calibrated against."""
+        if v_scale is not None:
+            return scaled_qdot("bhij,bhjd->bhid", attn, v,
+                               v_scale).astype(out_dtype)
         if v.dtype == out_dtype:
             # graftlint: disable=DOT001 (uniform: guarded by v.dtype == out_dtype, attn cast to it)
             return jnp.einsum("bhij,bhjd->bhid", attn.astype(out_dtype), v)

@@ -52,6 +52,7 @@ class AttnBlock(nn.Module):
     ring_axis: Optional[str] = None
     sp_impl: str = "ring"
     sliced_kv_decode: bool = True
+    aligned_span_decode: bool = True
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -64,7 +65,8 @@ class AttnBlock(nn.Module):
             pallas_block_k=self.pallas_block_k,
             ring_axis=self.ring_axis,
             sp_impl=self.sp_impl,
-            sliced_kv_decode=self.sliced_kv_decode, dtype=self.dtype,
+            sliced_kv_decode=self.sliced_kv_decode,
+            aligned_span_decode=self.aligned_span_decode, dtype=self.dtype,
             name="attn",
         )
         self.scale = self.param(
@@ -83,10 +85,10 @@ class AttnBlock(nn.Module):
         return out * self.scale.astype(out.dtype)
 
     def decode_step(self, x, cache_k, cache_v, index, mask=None,
-                    write_pos=None):
+                    write_pos=None, qw=None):
         h, ck, cv = self.attn.decode_step(
             self.norm(x).astype(x.dtype), cache_k, cache_v, index, mask=mask,
-            write_pos=write_pos
+            write_pos=write_pos, qw=qw
         )
         return h * self.scale.astype(h.dtype), ck, cv
 
@@ -112,12 +114,25 @@ class FFBlock(nn.Module):
             (1, 1, self.dim),
         )
 
-    def __call__(self, x, deterministic: bool = True):
-        h = self.dense_in(self.norm(x).astype(x.dtype))
+    def __call__(self, x, deterministic: bool = True, qw=None):
+        """``qw`` (decode path only, ``weights_int8``): this layer's
+        session-quantized kernels ``{"ff_in": (int8, scale, bias),
+        "ff_out": ...}`` — the GEGLU runs with int8 multiplicands and f32
+        accumulation instead of touching the f32 params."""
+        from .quant import qdense
+
+        normed = self.norm(x).astype(x.dtype)
+        if qw is not None:
+            h = qdense(normed, *qw["ff_in"]).astype(x.dtype)
+        else:
+            h = self.dense_in(normed)
         h, gates = jnp.split(h, 2, axis=-1)
         h = h * nn.gelu(gates)
         h = self.drop(h, deterministic=deterministic)
-        h = self.dense_out(h)
+        if qw is not None:
+            h = qdense(h, *qw["ff_out"]).astype(x.dtype)
+        else:
+            h = self.dense_out(h)
         return h * self.scale.astype(h.dtype)
 
 
@@ -186,6 +201,7 @@ class Transformer(nn.Module):
     ring_axis: Optional[str] = None  # sequence-parallel axis (inside shard_map)
     sp_impl: str = "ring"            # 'ring' | 'ulysses' (all-to-all)
     sliced_kv_decode: bool = True    # decode gathers only reachable keys
+    aligned_span_decode: bool = True  # serve-path circular reads as spans
     ff_experts: int = 0        # >1: MoE feed-forward with this many experts
     ff_expert_top_k: int = 2
     ff_expert_dispatch: str = "dense"        # 'dense' | 'capacity'
@@ -218,6 +234,7 @@ class Transformer(nn.Module):
                 pallas_block_k=self.pallas_block_k,
                 ring_axis=self.ring_axis, sp_impl=self.sp_impl,
                 sliced_kv_decode=self.sliced_kv_decode,
+                aligned_span_decode=self.aligned_span_decode,
                 dtype=self.dtype,
                 name=f"layers_{ind}_attn",
             ))
@@ -338,31 +355,39 @@ class Transformer(nn.Module):
             for _ in range(self.depth)
         ]
 
-    def decode_step(self, x, caches, index, mask=None, write_pos=None):
+    def decode_step(self, x, caches, index, mask=None, write_pos=None,
+                    qweights=None):
         """Single-token pass: x [b, 1, dim], per-layer KV caches, traced
         absolute position `index`.  Returns (out, new_caches).
 
         ``write_pos`` enables the phase-aligned serving mode (``index``
         may be per-row, caches rotated, one shared physical write column —
-        see MultiHeadAttention.decode_step).
+        see MultiHeadAttention.decode_step).  ``qweights`` is the
+        per-layer list of session-quantized int8 kernels
+        (models/dalle.py::quantize_decode_weights) consumed by the
+        attention projections and the FF blocks under ``weights_int8``.
 
         Mirrors the executor the model trains with: residual stack, or the
         reversible two-stream recurrence (whose attention reads the x2
         stream — caches must match what training computed)."""
+        qws = qweights if qweights is not None else [None] * self.depth
         new_caches = []
         if self.reversible:
             x1 = x2 = x
-            for attn, ff, (ck, cv) in zip(self.attn_blocks, self.ff_blocks, caches):
+            for attn, ff, (ck, cv), qw in zip(self.attn_blocks,
+                                              self.ff_blocks, caches, qws):
                 h, ck, cv = attn.decode_step(x2, ck, cv, index, mask=mask,
-                                             write_pos=write_pos)
+                                             write_pos=write_pos, qw=qw)
                 x1 = x1 + h
-                x2 = x2 + ff(x1)
+                # MoE FF blocks take no qw (weights_int8 asserts them away)
+                x2 = x2 + (ff(x1, qw=qw) if qw is not None else ff(x1))
                 new_caches.append((ck, cv))
             return (x1 + x2) / 2, new_caches
-        for attn, ff, (ck, cv) in zip(self.attn_blocks, self.ff_blocks, caches):
+        for attn, ff, (ck, cv), qw in zip(self.attn_blocks, self.ff_blocks,
+                                          caches, qws):
             h, ck, cv = attn.decode_step(x, ck, cv, index, mask=mask,
-                                         write_pos=write_pos)
+                                         write_pos=write_pos, qw=qw)
             x = x + h
-            x = x + ff(x)
+            x = x + (ff(x, qw=qw) if qw is not None else ff(x))
             new_caches.append((ck, cv))
         return x, new_caches

@@ -57,7 +57,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..models.dalle import DALLE, prefill_codes, sample_image_code
+from ..models.dalle import (DALLE, prefill_codes, quantize_decode_weights,
+                            sample_image_code)
+from ..ops.quant import split_cache
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,19 +90,37 @@ class SlotArena:
         self.geometry = ArenaGeometry(
             num_slots=num_slots, n_pre=cfg.text_seq_len + 1,
             image_seq_len=cfg.image_seq_len, seq_len=cfg.seq_len)
-        # cache STORAGE dtype matches what prefill returns (models/dalle.py
-        # casts to bf16 under kv_cache_bf16) — admit's astype is then a
-        # no-op and the arena carries the same byte-cut the static sampler
-        # measured (PERF.md: bf16 cache ≤0.6x cache I/O)
-        self._cache_dtype = (jnp.bfloat16 if cfg.kv_cache_bf16
+        # cache STORAGE layout matches what prefill returns (models/dalle.py
+        # quantizes under kv_cache_int8, casts to bf16 under kv_cache_bf16)
+        # — admit's astype is then a no-op and the arena carries the same
+        # byte-cut the static sampler measured.  Int8 arenas ride PER-SLOT
+        # per-head f32 scale planes [S, heads, 1, 1] next to the int8
+        # values; scale-plane init is ones, not zeros — a never-admitted
+        # slot's masked lane still divides by its scale in the tick's
+        # saturating re-quantize, and 0/0 would poison it with NaNs.
+        self._cache_dtype = (jnp.int8 if cfg.kv_cache_int8
+                             else jnp.bfloat16 if cfg.kv_cache_bf16
                              else cfg.dtype)
         S = num_slots
         cache_shape = (S, cfg.heads, cfg.seq_len, cfg.dim_head)
 
+        def fresh_entry():
+            values = jnp.zeros(cache_shape, self._cache_dtype)
+            if not cfg.kv_cache_int8:
+                return values
+            return (values, jnp.ones((S, cfg.heads, 1, 1), jnp.float32))
+
+        # weights_int8: the per-session one-shot quantization — computed
+        # here, once per arena, and passed to every tick as an argument
+        # (the tick's compiled program then consumes ONLY the int8 copies;
+        # jit prunes the unused f32 kernels from its argument list)
+        self._qweights = (jax.jit(
+            lambda v: quantize_decode_weights(v, cfg))(variables)
+            if cfg.weights_int8 else None)
+
         def fresh_state():
             return dict(
-                caches=[(jnp.zeros(cache_shape, self._cache_dtype),
-                         jnp.zeros(cache_shape, self._cache_dtype))
+                caches=[(fresh_entry(), fresh_entry())
                         for _ in range(cfg.depth)],
                 code=jnp.zeros((S,), jnp.int32),
                 index=jnp.zeros((S,), jnp.int32),
@@ -143,15 +163,24 @@ class SlotArena:
             its cache write one shared-column dynamic_update_slice."""
             rot = jnp.remainder(write_pos - jnp.int32(n_pre),
                                 jnp.int32(self.geometry.seq_len))
-            caches = []
-            for (ak, av), (k1, v1) in zip(state["caches"], caches1):
-                ak = jax.lax.dynamic_update_slice(
-                    ak, jnp.roll(k1.astype(ak.dtype), rot, axis=2),
+
+            def install(arena_entry, new_entry):
+                """Roll the prefilled values into the slot's rotation and
+                write them (one DUS); int8 entries also carry the slot's
+                per-head scale plane across — scales are write-position-
+                invariant, so only the values roll."""
+                vals, scale = split_cache(arena_entry)
+                new_vals, new_scale = split_cache(new_entry)
+                vals = jax.lax.dynamic_update_slice(
+                    vals, jnp.roll(new_vals.astype(vals.dtype), rot, axis=2),
                     (slot, 0, 0, 0))
-                av = jax.lax.dynamic_update_slice(
-                    av, jnp.roll(v1.astype(av.dtype), rot, axis=2),
-                    (slot, 0, 0, 0))
-                caches.append((ak, av))
+                if scale is None:
+                    return vals
+                return (vals, jax.lax.dynamic_update_slice(
+                    scale, new_scale, (slot, 0, 0, 0)))
+
+            caches = [(install(ak, k1), install(av, v1))
+                      for (ak, av), (k1, v1) in zip(state["caches"], caches1)]
             ks = jax.random.split(key, self.geometry.image_seq_len)
             code0 = sample_one(first_logits[0], ks[0], temp)
 
@@ -173,7 +202,7 @@ class SlotArena:
                     state["out"], out_row[None], (slot, 0)),
             )
 
-        def tick(variables, state, active, write_pos):
+        def tick(variables, state, active, write_pos, qweights):
             """One decode step over every slot (phase-aligned batched
             ``DALLE.decode_step``: per-slot logical ``index`` vector, one
             shared physical write column).  ``active`` [S] bool masks
@@ -181,10 +210,12 @@ class SlotArena:
             but their code/pos/index/out are held, and their junk cache
             write lands in the shared column — overwritten by the next
             admit, unreachable before it (the aligned mask only reaches
-            logical positions a resident actually wrote)."""
+            logical positions a resident actually wrote).  ``qweights``
+            (weights_int8) rides as a real argument so the executable's
+            weight stream is the int8 copies, never a baked-in constant."""
             logits, caches = dalle.apply(
                 variables, state["code"], state["caches"], state["index"],
-                None, write_pos, method=DALLE.decode_step)
+                None, write_pos, qweights, method=DALLE.decode_step)
             # per-slot key for THIS position, gathered from the pre-split
             # stream (no threefry in the tick)
             sub = jax.vmap(
@@ -237,7 +268,8 @@ class SlotArena:
         Mutates ``self.state`` (donated)."""
         self.state = self._tick(self.variables, self.state,
                                 jnp.asarray(active_mask),
-                                jnp.int32(clock % self.geometry.seq_len))
+                                jnp.int32(clock % self.geometry.seq_len),
+                                self._qweights)
 
     def fetch_codes(self, slot: int):
         """Host numpy of one slot's decoded codes [image_seq_len] — the

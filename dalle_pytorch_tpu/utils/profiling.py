@@ -94,16 +94,28 @@ def dalle_decode_cache_bytes(cfg, batch: int) -> int:
     (k, v) caches at [batch, heads, seq_len, dim_head]) — the decode
     loop's dominant HBM stream (PERF.md: the loop is measured
     bandwidth-bound on cache reads, sliced-KV 2.16x).  The storage dtype
-    follows ``cfg.kv_cache_bf16`` (bf16 even at f32 activations; the
-    knob's whole point) or the activation dtype when that is already
-    half-width.  ``tests/test_perf_model.py`` pins the compiled decode
-    step's cache I/O against this number."""
+    follows ``cfg.kv_cache_int8`` (one byte per element PLUS the f32
+    per-head scale planes [batch, heads, 1, 1] each cache carries —
+    counting the payload without the scales would let the cost-model
+    gate under-measure the true stream), then ``cfg.kv_cache_bf16``
+    (bf16 even at f32 activations; the knob's whole point), then the
+    activation dtype when that is already half-width.
+    ``tests/test_perf_model.py`` pins the compiled decode step's cache
+    I/O against this number."""
     import jax.numpy as jnp
 
-    half = cfg.kv_cache_bf16 or jnp.dtype(cfg.dtype).itemsize == 2
-    itemsize = 2 if half else 4
-    return (cfg.depth * 2 * batch * cfg.heads * cfg.seq_len * cfg.dim_head
-            * itemsize)
+    n_caches = cfg.depth * 2  # k and v per layer
+    if cfg.kv_cache_int8:
+        itemsize = 1
+    elif cfg.kv_cache_bf16 or jnp.dtype(cfg.dtype).itemsize == 2:
+        itemsize = 2
+    else:
+        itemsize = 4
+    total = (n_caches * batch * cfg.heads * cfg.seq_len * cfg.dim_head
+             * itemsize)
+    if cfg.kv_cache_int8:
+        total += n_caches * batch * cfg.heads * 4  # f32 scale planes
+    return total
 
 
 def compiled_cost_summary(fn, *args, donate_argnums=(),

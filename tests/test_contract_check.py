@@ -89,11 +89,13 @@ def test_full_cache_f32_upcast_is_caught(cc, monkeypatch):
     C3 must see the full-cache-sized convert in the decode jaxpr."""
     orig = DALLE.decode_step
 
-    def upcasting_decode_step(self, code, caches, index, mask=None):
+    def upcasting_decode_step(self, code, caches, index, mask=None,
+                              write_pos=None, qweights=None):
         dtypes = [(k.dtype, v.dtype) for k, v in caches]
         caches = [(k.astype(jnp.float32), v.astype(jnp.float32))
                   for k, v in caches]
-        logits, caches = orig(self, code, caches, index, mask)
+        logits, caches = orig(self, code, caches, index, mask, write_pos,
+                              qweights)
         # round-trip back to the storage dtype so the scan carry matches —
         # exactly the convert pair XLA would hoist into a resident f32 copy
         caches = [(k.astype(dk), v.astype(dv))
@@ -111,7 +113,7 @@ def test_dropped_f32_accumulation_is_caught(cc, monkeypatch):
     """Stripping preferred_element_type from the decode attn@v contraction
     reverts to bf16 accumulation — C2 must flag the bf16 dot."""
 
-    def sloppy_attn_v(attn, v, out_dtype):
+    def sloppy_attn_v(attn, v, v_scale, out_dtype):
         return jnp.einsum("bhij,bhjd->bhid", attn.astype(v.dtype),
                           v).astype(out_dtype)
 
@@ -120,6 +122,53 @@ def test_dropped_f32_accumulation_is_caught(cc, monkeypatch):
     cfg = cc.tiny_config(kv_cache_bf16=True)
     with pytest.raises(cc.ContractViolation, match="bf16 operand"):
         cc.check_decode_dots_accumulate_f32(cfg)
+
+
+# --- int8 quantized serving (ISSUE 7) -------------------------------------
+
+
+def test_int8_contracts_hold(cc):
+    cfg = cc.tiny_config(kv_cache_int8=True, weights_int8=True)
+    cc.check_cache_dtype(cfg)
+    cc.check_decode_dots_accumulate_f32(cfg)
+    cc.check_no_f32_cache_materialization(cfg)
+    cc.check_serve_tick_no_dequant(cfg)
+
+
+def test_int8_cache_layout_lie_is_caught(cc):
+    """A prefill that keeps float caches while the config claims int8
+    storage must fail C1's layout check."""
+    cfg_flag_on = cc.tiny_config(kv_cache_int8=True)
+    liar = DALLE(dataclasses.replace(cfg_flag_on, kv_cache_int8=False))
+    with pytest.raises(cc.ContractViolation, match="int8, scale"):
+        cc.check_cache_dtype(cfg_flag_on, dalle=liar)
+
+
+def test_dequantized_weight_hoist_is_caught(cc, monkeypatch):
+    """A qdense that dequantizes the whole kernel before the dot (int8 ->
+    f32 at full weight size — exactly what XLA would hoist out of the
+    decode loop) must fail C3's weight walk, in the decode AND the
+    serve-tick jaxpr."""
+    from dalle_pytorch_tpu.ops import attention as attn_mod
+    from dalle_pytorch_tpu.ops import quant as quant_mod
+
+    def dequantizing_qdense(x, qkernel, scale, bias=None,
+                            mul_dtype=jnp.bfloat16):
+        w = qkernel.astype(jnp.float32) * scale
+        spec = {2: "...a,ab->...b", 4: "...a,abcd->...bcd"}[qkernel.ndim]
+        out = jnp.einsum(spec, x.astype(jnp.float32), w,
+                         preferred_element_type=jnp.float32)
+        return out if bias is None else out + bias
+
+    # both the module-level import binding (attention) and the local
+    # imports (FFBlock, DALLE._head) must see the broken version
+    monkeypatch.setattr(quant_mod, "qdense", dequantizing_qdense)
+    monkeypatch.setattr(attn_mod, "qdense", dequantizing_qdense)
+    cfg = cc.tiny_config(kv_cache_int8=True, weights_int8=True)
+    with pytest.raises(cc.ContractViolation, match="dequantized weight"):
+        cc.check_no_f32_cache_materialization(cfg)
+    with pytest.raises(cc.ContractViolation, match="dequantized weight"):
+        cc.check_serve_tick_no_dequant(cfg)
 
 
 def test_strategy_misconfiguration_is_caught(cc):

@@ -254,3 +254,90 @@ def test_arena_geometry_and_cache_dtype(small):
         assert k.shape == (4, cfg.heads, cfg.seq_len, cfg.dim_head)
         assert k.dtype == jnp.bfloat16  # kv_cache_bf16 default ON
         assert v.dtype == jnp.bfloat16
+
+
+# --- int8 quantized serving (ISSUE 7) -------------------------------------
+
+
+import dataclasses  # noqa: E402
+
+
+def _int8_setup(small, **overrides):
+    """The `small` fixture's model re-planned for int8 serving (same
+    params — the quantization flags are plan fields, not model identity)
+    plus fresh greedy references through the int8 static sampler."""
+    cfg, _, params, texts, _ = small
+    cfg8 = dataclasses.replace(cfg, kv_cache_int8=True, **overrides)
+    dalle8 = DALLE(cfg8)
+    prefill = jax.jit(lambda p, t: prefill_codes(dalle8, p, t))
+
+    def greedy_ref(i):
+        fl, caches = prefill(params, jnp.asarray(texts[i])[None])
+        return np.asarray(decode_codes(
+            dalle8, params, fl, caches, jax.random.PRNGKey(7),
+            filter_thres=1.0))[0]
+
+    return cfg8, dalle8, params, texts, [greedy_ref(i) for i in range(4)]
+
+
+@pytest.mark.parametrize("weights", [False, True])
+def test_int8_serve_bit_matches_static_sampler(small, weights):
+    """ISSUE 7 satellite: greedy serve through the int8 arena (per-slot
+    scale planes, rotated int8 caches, session-quantized weights) is
+    BIT-IDENTICAL to the int8 static `decode_codes` sampler, across
+    mid-flight admissions — and still compiles each entry point once."""
+    cfg8, dalle8, params, texts, refs = _int8_setup(
+        small, weights_int8=weights)
+    srv = GenerationServer(dalle8, params, num_slots=2, filter_thres=1.0)
+    h0 = srv.submit(texts[0])
+    for _ in range(5):
+        srv.step()
+    h1 = srv.submit(texts[1])          # joins mid-flight
+    for _ in range(3):
+        srv.step()
+    h2 = srv.submit(texts[2])          # queued: both slots busy
+    srv.run_until_idle(max_ticks=300)
+    for h, r in ((h0, refs[0]), (h1, refs[1]), (h2, refs[2])):
+        np.testing.assert_array_equal(h.result(0), r)
+    assert srv.trace_counts() == {"prefill": 1, "admit": 1, "tick": 1}
+
+
+def test_int8_arena_carries_scale_planes(small):
+    """The int8 arena's cache entries are (int8 values, f32 per-slot
+    per-head scale) pairs, scale planes init to ones (a zero scale would
+    NaN the masked lanes' saturating re-quantize)."""
+    cfg8, dalle8, params, _, _ = _int8_setup(small)
+    arena = SlotArena(dalle8, params, num_slots=3)
+    for k, v in arena.state["caches"]:
+        for values, scale in (k, v):
+            assert values.dtype == jnp.int8
+            assert values.shape == (3, cfg8.heads, cfg8.seq_len,
+                                    cfg8.dim_head)
+            assert scale.dtype == jnp.float32
+            assert scale.shape == (3, cfg8.heads, 1, 1)
+            np.testing.assert_array_equal(np.asarray(scale), 1.0)
+
+
+@pytest.mark.parametrize("int8", [False, True])
+def test_aligned_span_reads_bit_match_gather(small, int8):
+    """ISSUE 7 satellite (carried PR 6 follow-up): the serve path's
+    circular-span sliced reads (aligned_span_decode=True, ≤2
+    dynamic_slice spans per row) are BIT-IDENTICAL to the vmapped-gather
+    control across mid-flight admissions, clock wrap, and sampled (non-
+    greedy) decoding — same key order, values and masks, only the HBM
+    access pattern differs."""
+    cfg, _, params, texts, _ = small
+    outs = {}
+    for span in (True, False):
+        cfg_v = dataclasses.replace(cfg, kv_cache_int8=int8,
+                                    aligned_span_decode=span)
+        srv = GenerationServer(DALLE(cfg_v), params, num_slots=2,
+                               filter_thres=0.5)
+        hs = [srv.submit(texts[i % len(texts)],
+                         key=np.asarray([9, i], np.uint32))
+              for i in range(5)]  # 5 requests through 2 slots: clock wraps
+        srv.run_until_idle(max_ticks=1000)
+        outs[span] = [h.result(0) for h in hs]
+        assert srv.trace_counts() == {"prefill": 1, "admit": 1, "tick": 1}
+    for a, b in zip(outs[True], outs[False]):
+        np.testing.assert_array_equal(a, b)

@@ -29,9 +29,9 @@ cd "$(dirname "$0")/.."
 # re-armed queue whose stage COMMANDS changed can never be skipped by a
 # stale marker from an older queue definition — bump QV whenever any
 # stage's command line changes.
-QV=10
+QV=11
 
-STAGES="gen_bf16_ab gen_fused_ab ab_cand bench gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap bench_serve"
+STAGES="gen_bf16_ab gen_int8_ab gen_fused_ab ab_cand bench gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap bench_serve"
 
 # Overridable knobs so tests/test_babysitter.py can drive the REAL script
 # (fake python on PATH, private marker dir, second-scale sleeps) without
@@ -220,6 +220,11 @@ fi
 # this is the round's headline decode A/B.  Two cold decode-scan compiles
 # per stage is the ceiling (bench.py bounds one at 900s)
 run_stage gen_bf16_ab 2400 python tools/perf_ab.py gen_bf16 gen_f32cache --reps 2
+# int8 quantized serving (ISSUE 7) vs the bf16 cache it halves again:
+# int8 KV cache + int8 decode weights at eval dtype — the wall-clock side
+# of the ≤0.55x compiler gate (tests/test_perf_model.py) and the C2/C3
+# no-dequant contracts, queued directly behind its bf16 control
+run_stage gen_int8_ab 2400 python tools/perf_ab.py gen_int8 gen_bf16 --reps 2
 # fused generate→VAE-decode→CLIP-rerank pipeline wall-clock (genrank
 # rank_codes: shared prefill + zero disk round-trips), images-ranked/sec
 run_stage gen_fused_ab 1800 python tools/perf_ab.py gen_fused_rank --reps 2

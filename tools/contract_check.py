@@ -11,23 +11,30 @@ seconds on CPU with **zero FLOPs**: everything goes through
 the chip queue (tools/chip_babysitter.sh runs this ahead of the A/B
 stages).
 
-Checked contracts (see ISSUE 2 / PERF.md "bf16 sliced-KV cache"):
+Checked contracts (see ISSUE 2 / PERF.md "bf16 sliced-KV cache" and
+ISSUE 7 "int8 quantized serving"):
 
 * C1 cache dtype — ``DALLE.prefill`` returns bf16 caches iff
-  ``kv_cache_bf16`` (or the model itself runs bf16); head logits stay f32.
-* C2 f32 accumulation — in the decode jaxpr every dot with a bf16 operand
-  carries ``preferred_element_type=f32`` (the MXU bf16-in/f32-acc mode);
-  applies to f32-activation models, where a bf16 operand can only be the
-  cache.
-* C3 no full-cache f32 materialization — the decode jaxpr contains no
-  bf16->f32 convert of a full-cache-sized array (the XLA hoist that
-  defeated the bf16 cache until PR 1 pinned cache-dtype multiplicands).
+  ``kv_cache_bf16`` (or the model itself runs bf16), and ``(int8 values,
+  f32 per-head scale)`` pairs iff ``kv_cache_int8``; head logits stay
+  f32.
+* C2 f32 accumulation — in the decode jaxpr every dot with a bf16 OR
+  int8 operand carries ``preferred_element_type=f32`` (the MXU's
+  low-precision-in/f32-acc mode); applies to f32-activation models,
+  where such an operand can only be the stored cache or a quantized
+  weight.
+* C3 no full-cache / full-weight dequant materialization — the decode
+  jaxpr (and, under the int8 flags, the serve-tick jaxpr) contains no
+  bf16/int8 -> f32 convert of a full-cache-sized array and no int8 ->
+  f32/bf16 convert of a full-weight-sized array (the XLA hoist that
+  defeated the bf16 cache until PR 1 pinned cache-dtype multiplicands —
+  the int8 recipe has the same failure mode one byte lower).
 * C4 shardings resolve — for all five parallel strategies (dp, fsdp, tp,
   sp-ring, sp-ulysses) the strategy's step traces and its shardings
   lower/partition on a virtual mesh.
 * C5 config variants instantiate — the pallas tile ladder (128/256/512)
-  and both KV-cache dtypes prefill to the expected shapes at the
-  production CUB geometry.
+  and all three KV-cache storage layouts prefill to the expected shapes
+  at the production CUB geometry.
 
 Usage:
     JAX_PLATFORMS=cpu python tools/contract_check.py [--quick]
@@ -40,6 +47,7 @@ import argparse
 import dataclasses
 import sys
 from pathlib import Path
+from typing import Optional
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
@@ -154,7 +162,8 @@ def _decode_jaxpr(cfg: DALLEConfig, dalle=None, batch: int = 2):
 
 
 def check_cache_dtype(cfg: DALLEConfig, dalle=None) -> None:
-    """prefill caches are bf16 iff kv_cache_bf16 (or a bf16 model); the
+    """prefill caches are bf16 iff kv_cache_bf16 (or a bf16 model), and
+    (int8 values, f32 per-head scale) pairs iff kv_cache_int8; the
     logits head output stays f32 regardless."""
     dalle = dalle or DALLE(cfg)
     _, _, logits, kvs = _prefill_shapes(dalle)
@@ -162,15 +171,33 @@ def check_cache_dtype(cfg: DALLEConfig, dalle=None) -> None:
                                 or cfg.dtype == jnp.bfloat16) else jnp.float32
     for i, (k, v) in enumerate(kvs):
         for name, leaf in (("k", k), ("v", v)):
-            if leaf.dtype != expected:
+            if cfg.kv_cache_int8:
+                if not (isinstance(leaf, tuple) and len(leaf) == 2):
+                    raise ContractViolation(
+                        f"layer {i} cache {name} is not an (int8, scale) "
+                        f"pair under kv_cache_int8: {type(leaf).__name__}")
+                values, scale = leaf
+                if values.dtype != jnp.int8:
+                    raise ContractViolation(
+                        f"layer {i} cache {name} values dtype "
+                        f"{values.dtype} != int8 (kv_cache_int8=True)")
+                b, h = values.shape[0], values.shape[1]
+                if scale.dtype != jnp.float32 or scale.shape != (b, h, 1, 1):
+                    raise ContractViolation(
+                        f"layer {i} cache {name} scale {scale.dtype}"
+                        f"{scale.shape} != f32 per-head plane "
+                        f"{(b, h, 1, 1)} — the ops/quant.py scale-layout "
+                        "contract")
+                leaf = values
+            elif leaf.dtype != expected:
                 raise ContractViolation(
                     f"layer {i} cache {name} dtype {leaf.dtype} != "
                     f"{jnp.dtype(expected).name} (kv_cache_bf16="
                     f"{cfg.kv_cache_bf16}, dtype={jnp.dtype(cfg.dtype).name})")
-        if k.shape[2] != cfg.seq_len:
-            raise ContractViolation(
-                f"layer {i} cache holds {k.shape[2]} positions, "
-                f"expected seq_len={cfg.seq_len}")
+            if name == "k" and leaf.shape[2] != cfg.seq_len:
+                raise ContractViolation(
+                    f"layer {i} cache holds {leaf.shape[2]} positions, "
+                    f"expected seq_len={cfg.seq_len}")
     if logits.dtype != jnp.float32:
         raise ContractViolation(
             f"prefill logits dtype {logits.dtype} != float32 — the head "
@@ -185,44 +212,139 @@ def check_cache_dtype(cfg: DALLEConfig, dalle=None) -> None:
 
 
 def check_decode_dots_accumulate_f32(cfg: DALLEConfig, dalle=None) -> None:
-    """Every dot in the decode program with a bf16 operand must state f32
-    accumulation.  Only meaningful for f32-activation models (checkpoint
-    eval dtype): there, a bf16 operand can only be the stored cache."""
+    """Every dot in the decode program with a bf16 or int8 operand must
+    state f32 accumulation.  Only meaningful for f32-activation models
+    (checkpoint eval dtype): there, such an operand can only be the
+    stored cache or a session-quantized weight."""
     if cfg.dtype != jnp.float32:
         raise ValueError("C2 applies to f32-activation configs only")
     jaxpr, _ = _decode_jaxpr(cfg, dalle)
+    low = (jnp.bfloat16, jnp.int8)
     for eqn in _iter_eqns(jaxpr.jaxpr):
         if eqn.primitive.name != "dot_general":
             continue
-        if not any(v.aval.dtype == jnp.bfloat16 for v in eqn.invars):
+        hits = [v.aval.dtype for v in eqn.invars if v.aval.dtype in low]
+        if not hits:
             continue
         pref = eqn.params.get("preferred_element_type")
         if pref is None or jnp.dtype(pref) != jnp.dtype(jnp.float32):
+            name = "bf16" if hits[0] == jnp.bfloat16 else "int8"
             raise ContractViolation(
-                f"decode dot_general with bf16 operand accumulates in "
+                f"decode dot_general with {name} operand accumulates in "
                 f"{pref or 'operand dtype'} (line {eqn.source_info.traceback}"
                 f") — must be preferred_element_type=f32")
 
 
-def check_no_f32_cache_materialization(cfg: DALLEConfig, dalle=None) -> None:
-    """The decode program never converts a full-cache-sized bf16 array to
-    f32 — the hoist that would silently double decode HBM traffic and
-    defeat kv_cache_bf16 (PR 1's measured failure mode)."""
-    jaxpr, kvs = _decode_jaxpr(cfg, dalle)
-    cache_elems = min(int(np.prod(k.shape)) for k, _ in kvs)
-    for eqn in _iter_eqns(jaxpr.jaxpr):
+def _cache_elems(kvs) -> int:
+    """Smallest per-layer cache element count; int8 entries are (values,
+    scale) pairs."""
+    sizes = []
+    for k, _ in kvs:
+        values = k[0] if isinstance(k, tuple) else k
+        sizes.append(int(np.prod(values.shape)))
+    return min(sizes)
+
+
+def _min_weight_elems(cfg: DALLEConfig, variables) -> int:
+    """Smallest quantized decode-weight kernel (element count) — the
+    threshold above which an int8->float convert means a dequantized
+    weight copy, not a per-step activation."""
+    from dalle_pytorch_tpu.models.dalle import quantize_decode_weights
+
+    qw = jax.eval_shape(lambda v: quantize_decode_weights(v, cfg),
+                        variables)
+    sizes = [int(np.prod(leaf.shape))
+             for leaf in jax.tree.leaves(qw)
+             if leaf.dtype == jnp.int8]
+    return min(sizes)
+
+
+def _scan_dequant_converts(jaxpr, cache_elems: int,
+                           weight_elems: Optional[int], label: str) -> None:
+    """The shared C3 walk: no low-precision -> f32 convert at or above
+    full-cache size, and (when weights are quantized) no int8 -> float
+    convert at or above full-weight size."""
+    low = (jnp.bfloat16, jnp.int8)
+    for eqn in _iter_eqns(jaxpr):
         if eqn.primitive.name != "convert_element_type":
             continue
         (invar,), (outvar,) = eqn.invars, eqn.outvars
         if getattr(invar, "aval", None) is None:
             continue
-        if invar.aval.dtype == jnp.bfloat16 \
-                and outvar.aval.dtype == jnp.float32 \
-                and int(np.prod(outvar.aval.shape)) >= cache_elems:
+        src, dst = invar.aval.dtype, outvar.aval.dtype
+        size = int(np.prod(outvar.aval.shape))
+        # the weight rule first: an int8 convert that clears the (smaller)
+        # weight threshold is a dequantized kernel, the sharper diagnosis
+        if weight_elems is not None and src == jnp.int8 \
+                and dst in (jnp.float32, jnp.bfloat16) \
+                and size >= weight_elems:
             raise ContractViolation(
-                f"decode program materializes a full-cache f32 copy: "
-                f"convert_element_type bf16->f32 of shape "
+                f"{label} program materializes a dequantized weight copy: "
+                f"convert_element_type int8->{dst} of shape "
+                f"{outvar.aval.shape} (>= weight size {weight_elems})")
+        if src in low and dst == jnp.float32 and size >= cache_elems:
+            raise ContractViolation(
+                f"{label} program materializes a full-cache f32 copy: "
+                f"convert_element_type {src}->f32 of shape "
                 f"{outvar.aval.shape} (>= cache size {cache_elems})")
+
+
+def check_no_f32_cache_materialization(cfg: DALLEConfig, dalle=None) -> None:
+    """The decode program never converts a full-cache-sized bf16/int8
+    array to f32 — the hoist that would silently double decode HBM
+    traffic and defeat kv_cache_bf16/kv_cache_int8 (PR 1's measured
+    failure mode) — nor, under weights_int8, a full-weight-sized int8
+    array to any float."""
+    dalle = dalle or DALLE(cfg)
+    jaxpr, kvs = _decode_jaxpr(cfg, dalle)
+    weight_elems = None
+    if cfg.weights_int8:
+        variables, _ = _init_shapes(dalle)
+        weight_elems = _min_weight_elems(cfg, variables)
+    _scan_dequant_converts(jaxpr.jaxpr, _cache_elems(kvs), weight_elems,
+                           "decode")
+
+
+def check_serve_tick_no_dequant(cfg: DALLEConfig, num_slots: int = 2) -> None:
+    """C3 over the SERVE-TICK jaxpr: the phase-aligned batched decode
+    step the arena runs every tick (per-slot index vector, shared write
+    column, session-quantized weight arguments) must be as free of
+    dequant hoists as the static decode scan — a full-precision copy
+    here would re-pay the cache/weight bytes on every tick for every
+    slot."""
+    dalle = DALLE(cfg)
+    variables, _ = _init_shapes(dalle, batch=1)
+    S = num_slots
+    cache_shape = (S, cfg.heads, cfg.seq_len, cfg.dim_head)
+    if cfg.kv_cache_int8:
+        entry = (_sds(cache_shape, jnp.int8),
+                 _sds((S, cfg.heads, 1, 1), jnp.float32))
+    else:
+        entry = _sds(cache_shape,
+                     jnp.bfloat16 if (cfg.kv_cache_bf16
+                                      or cfg.dtype == jnp.bfloat16)
+                     else cfg.dtype)
+    caches = [(entry, entry) for _ in range(cfg.depth)]
+    code = _sds((S,), jnp.int32)
+    index = _sds((S,), jnp.int32)
+    write_pos = _sds((), jnp.int32)
+    weight_elems = None
+    qw = None
+    if cfg.weights_int8:
+        from dalle_pytorch_tpu.models.dalle import quantize_decode_weights
+
+        qw = jax.eval_shape(lambda v: quantize_decode_weights(v, cfg),
+                            variables)
+        weight_elems = _min_weight_elems(cfg, variables)
+
+    def tick(v, code, caches, index, write_pos, qw):
+        return dalle.apply(v, code, caches, index, None, write_pos, qw,
+                           method=DALLE.decode_step)
+
+    jaxpr = jax.make_jaxpr(tick)(variables, code, caches, index, write_pos,
+                                 qw)
+    _scan_dequant_converts(jaxpr.jaxpr, _cache_elems(caches), weight_elems,
+                           "serve-tick")
 
 
 # --- C4: parallel strategies --------------------------------------------
@@ -320,6 +442,24 @@ def run_all(quick: bool = False) -> int:
             check_no_f32_cache_materialization, cfg)
     run("C1 cache dtype [dtype=bf16]", check_cache_dtype,
         make_cfg(dtype=jnp.bfloat16, kv_cache_bf16=False))
+    # int8 quantized serving (ISSUE 7): cache-only, then cache + weights;
+    # C3 additionally walks the serve-tick jaxpr — both decode programs
+    # must stay free of dequant hoists
+    cfg_i8 = make_cfg(kv_cache_int8=True)
+    run("C1 cache dtype [kv_cache_int8]", check_cache_dtype, cfg_i8)
+    run("C2 f32 accumulation [kv_cache_int8]",
+        check_decode_dots_accumulate_f32, cfg_i8)
+    run("C3 no dequant materialization [kv_cache_int8]",
+        check_no_f32_cache_materialization, cfg_i8)
+    cfg_i8w = make_cfg(kv_cache_int8=True, weights_int8=True)
+    run("C2 f32 accumulation [int8 cache+weights]",
+        check_decode_dots_accumulate_f32, cfg_i8w)
+    run("C3 no dequant materialization [int8 cache+weights]",
+        check_no_f32_cache_materialization, cfg_i8w)
+    run("C3 serve-tick no dequant [int8 cache+weights]",
+        check_serve_tick_no_dequant, cfg_i8w)
+    run("C3 serve-tick no dequant [bf16 cache]",
+        check_serve_tick_no_dequant, make_cfg())
     for name in STRATEGIES:
         run(f"C4 shardings resolve [{name}]", check_strategy, name)
     for block in PALLAS_TILES if not quick else PALLAS_TILES[:1]:

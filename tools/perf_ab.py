@@ -90,8 +90,15 @@ VARIANTS = {
 # arrival trace at 1.25x oversubscription) — aggregate tok/s across
 # INTERLEAVED requests; serve64 is the direct A/B against gen64's
 # static-batch 35.2k tok/s headline.
+# gen_int8: the ISSUE 7 quantized-serving recipe on the static sampler at
+# eval dtype (f32 activations): int8 KV cache (per-head scales) + int8
+# decode weights (per-output-channel scales, one-shot per session) — the
+# wall-clock side of the ≤0.55x-cache-bytes compiler gate; its direct
+# control is gen_bf16 (same dtype, bf16 cache, f32 weights).
+# serve_int8: the same recipe on the 64-slot serve arena (per-SLOT scale
+# planes, int8 weight args on every tick) vs serve64's bf16 arena.
 EXTRAS = ("gen", "gen64", "vae", "gen-dense", "gen_bf16", "gen_f32cache",
-          "gen_fused_rank", "serve64", "serve16")
+          "gen_fused_rank", "serve64", "serve16", "gen_int8", "serve_int8")
 
 
 def main(argv=None) -> int:
@@ -149,11 +156,23 @@ def main(argv=None) -> int:
             measures[name] = bench.make_gen_measure(
                 batch=8, dtype=jnp.float32,
                 kv_cache_bf16=(name == "gen_bf16"))
+        elif name == "gen_int8":
+            # int8 quantized serving (ISSUE 7) at the eval path's f32
+            # activations: int8 cache + int8 decode weights, both riding
+            # the traced config — A/B control is gen_bf16
+            measures[name] = bench.make_gen_measure(
+                batch=8, dtype=jnp.float32, kv_cache_int8=True,
+                weights_int8=True)
         elif name == "gen_fused_rank":
             measures[name] = bench.make_fused_rank_measure(batch=8)
         elif name in ("serve64", "serve16"):
             measures[name] = bench.make_serve_measure(
                 num_slots=64 if name == "serve64" else 16)
+        elif name == "serve_int8":
+            # the quantized 64-slot arena (per-slot scale planes, int8
+            # weight args per tick) vs serve64's bf16 arena
+            measures[name] = bench.make_serve_measure(
+                num_slots=64, kv_cache_int8=True, weights_int8=True)
         elif name == "vae":
             measures[name] = bench.make_vae_measure()
         else:

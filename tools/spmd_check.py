@@ -333,7 +333,7 @@ def decode_jaxpr(make_cfg=tiny_config, batch: int = 2):
     return jax.make_jaxpr(run)(variables, logits, kvs, rng)
 
 
-def serve_retrace_check(num_slots: int = 3):
+def serve_retrace_check(num_slots: int = 3, **cfg_overrides):
     """S3 for the continuous-batching serve tick (ISSUE 6): drive a real
     GenerationServer over the tiny model through admit/retire churn —
     occupancy rising 1 -> num_slots mid-flight, requests retiring at
@@ -343,12 +343,15 @@ def serve_retrace_check(num_slots: int = 3):
     shape anywhere in the arena turns every arrival into a recompile on
     the pod (the storm `lint/spmd_fixtures.py::
     make_shape_changing_serve_tick` exhibits, proven caught in the
-    selftest)."""
+    selftest).  ``cfg_overrides`` select plan variants — the int8 arena
+    (kv_cache_int8 + weights_int8, ISSUE 7) re-runs the same churn over
+    the quantized cache/scale planes and the session-quantized weight
+    arguments."""
     import numpy as np
 
     from dalle_pytorch_tpu.serve import GenerationServer
 
-    cfg = tiny_config()
+    cfg = tiny_config(**cfg_overrides)
     dalle = DALLE(cfg)
     text = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
     codes = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
@@ -482,8 +485,12 @@ def run_all(chip: str = "v4-8", quick: bool = False,
             decode_jaxpr(), label="decode")) or "no collectives")
     # the continuous-batching serve tick: admit/retire churn across
     # occupancies must reuse ONE executable per entry point (ISSUE 6
-    # acceptance gate, chip-free twin of tests/test_serve.py)
+    # acceptance gate, chip-free twin of tests/test_serve.py); the int8
+    # arena variant (ISSUE 7) proves the quantized cache/scale planes and
+    # session-quantized weight arguments keep the same property
     run("S3-retrace", "serve-tick", serve_retrace_check)
+    run("S3-retrace", "serve-tick-int8",
+        lambda: serve_retrace_check(kv_cache_int8=True, weights_int8=True))
 
     # S2 per plan at tiny geometry, FULL-opt compile (donation honoring
     # is structural — layout/sharding mismatches reproduce at any size —
