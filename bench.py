@@ -373,6 +373,61 @@ def make_serve_measure(num_slots: int = 64, requests_per_slot: int = 2,
     return measure
 
 
+def make_ingest_measure(data_format: str, src, shards, batch: int = 16,
+                        image_size: int = 64, num_workers: int = 8,
+                        sim_step_s: float = 0.005):
+    """Host-only input-pipeline throughput: one full epoch of the given
+    pipeline (``folder`` = the loose-file datasets, ``shards`` = the
+    streaming tar pipeline) pulled through the DevicePrefetcher, with a
+    simulated ``sim_step_s`` device step per batch so the measured *stall
+    fraction* (prefetcher wait over wall-clock) means what it means in a
+    real run: ~0 = the loader hides behind the step, ~1 = the chip would
+    idle on input.  Each ``measure()`` returns ``(images_per_sec, dt)``
+    and prints the stall fraction to stderr — the BENCH_INGEST stage runs
+    it for both formats so a regression in either pipeline (or the gap
+    between them) is a number, not a hunch."""
+    from dalle_pytorch_tpu.data import stream as dstream
+    from dalle_pytorch_tpu.data.dataset import DataLoader, TextImageDataset
+
+    class _HashTok:  # host-only stand-in: ingest measures IO+decode, not BPE
+        def tokenize(self, text, context_length, truncate_text=False):
+            import numpy as np
+
+            ids = [sum(map(ord, w)) % 997 + 1 for w in text.split()]
+            out = np.zeros((1, context_length), np.int64)
+            out[0, : len(ids[:context_length])] = ids[:context_length]
+            return out
+
+    tok = _HashTok()
+    if data_format == "shards":
+        ds = dstream.ShardStreamDataset(
+            shards, tok, text_len=16, image_size=image_size,
+            resize_ratio=0.8)
+        dl = dstream.StreamingDataLoader(ds, batch, shuffle=True, seed=0,
+                                         num_workers=num_workers)
+    else:
+        ds = TextImageDataset(src, tok, text_len=16, image_size=image_size,
+                              resize_ratio=0.8)
+        dl = DataLoader(ds, batch, shuffle=True, seed=0,
+                        num_workers=num_workers)
+
+    def measure():
+        pf = dstream.DevicePrefetcher(dl, depth=1)
+        n = 0
+        t0 = time.perf_counter()
+        for b in pf:
+            n += len(b[0])
+            if sim_step_s:
+                time.sleep(sim_step_s)
+        dt = time.perf_counter() - t0
+        frac = min(pf.total_wait_s / dt, 1.0) if dt else 0.0
+        print(f"ingest[{data_format}]: stall fraction {frac:.2f} "
+              f"({pf.batches} batches)", file=sys.stderr)
+        return n / dt, dt
+
+    return measure
+
+
 def make_fused_rank_measure(batch: int = 8, num_images: int = 16,
                             **overrides):
     """Compile the fused generate -> VAE-decode -> CLIP-rerank pipeline
@@ -727,6 +782,46 @@ def main():
                             "value": round(vae_result[0], 2),
                             "unit": "images/sec",
                             "meta": {"batch": 8}})
+    if env_flag("BENCH_INGEST"):
+        # opt-in host-only ingest stage: synthetic corpus -> folder vs
+        # shards img/s + stall fraction.  No device work at all — this is
+        # the "is the input pipeline the bottleneck" number, safe to run
+        # even when the chip tunnel is dead.
+        def ingest_stage():
+            import tempfile
+            from pathlib import Path
+
+            import numpy as np
+            from PIL import Image
+
+            from dalle_pytorch_tpu.data import stream as dstream
+
+            tmp = Path(tempfile.mkdtemp(prefix="bench-ingest-"))
+            src = tmp / "src"
+            src.mkdir()
+            rng = np.random.default_rng(0)
+            n = int(os.environ.get("BENCH_INGEST_SAMPLES", "128"))
+            for i in range(n):
+                img = (rng.uniform(size=(96, 96, 3)) * 255).astype(np.uint8)
+                Image.fromarray(img).save(src / f"s{i:05d}.png")
+                (src / f"s{i:05d}.txt").write_text("a synthetic caption\n")
+            dstream.build_shards(src, tmp / "shards", samples_per_shard=32)
+            out = {}
+            for fmt in ("folder", "shards"):
+                m = make_ingest_measure(fmt, src, tmp / "shards")
+                m()  # warm: thread-pool spin-up + page cache
+                out[fmt] = m()
+            return out
+
+        ingest_result = bounded_stage(
+            "ingest", ingest_stage,
+            lambda r: "ingest: " + ", ".join(
+                f"{fmt} {v[0]:.1f} img/s" for fmt, v in r.items()))
+        if ingest_result is not None:
+            for fmt, (ips, _dt) in ingest_result.items():
+                record_history({"metric": "ingest_throughput",
+                                "value": round(ips, 1), "unit": "images/sec",
+                                "meta": {"format": fmt, "host_only": True}})
     if env_flag("BENCH_SERVE"):  # opt-in continuous-batching serve stage
         serve_slots = int(os.environ.get("BENCH_SERVE_SLOTS", "64"))
         # compile bound mirrors the gen stages: the serve tick compile is

@@ -88,6 +88,29 @@ def random_resized_crop(img, size: int, rng: np.random.Generator,
     return center_crop_resize(img, size)  # fallback, as torchvision does
 
 
+def make_pair(caption_text: str, load_image, tokenizer, text_len: int,
+              truncate_captions: bool, image_size: int, resize_ratio: float,
+              rng: np.random.Generator):
+    """The ONE (caption, image) sample-decode sequence, shared by the folder
+    dataset and the streaming shard reader (data/stream.py) so the two
+    formats stay bitwise-interchangeable: caption line draw FIRST, then
+    tokenize, then the (possibly failing) image load, then the crop draws —
+    any reordering changes which rng draw feeds which decision and breaks
+    the cross-format equality the streaming tests pin.  ``load_image`` is a
+    thunk so a failed image read happens *after* the caption draw, exactly
+    as the folder path has always sequenced it."""
+    descriptions = [line for line in caption_text.split("\n") if line.strip()]
+    if not descriptions:
+        raise ValueError("empty caption text")
+    description = descriptions[int(rng.integers(len(descriptions)))]
+    tokens = tokenizer.tokenize(
+        description, text_len, truncate_text=truncate_captions)[0]
+    img = load_image()
+    arr = random_resized_crop(img, image_size, rng,
+                              scale=(resize_ratio, 1.0))
+    return tokens, arr
+
+
 class ImageFolderDataset:
     """Recursively lists images under `folder`; yields [H, W, 3] float32."""
 
@@ -166,20 +189,17 @@ class TextImageDataset:
                 "refusing to silently train on what is left")
 
     def _read_sample(self, key: str, rng):
-        descriptions = [
-            line for line in self.text_files[key].read_text().split("\n")
-            if line.strip()
-        ]
-        if not descriptions:
-            raise ValueError(f"empty caption file {self.text_files[key]}")
-        description = descriptions[int(rng.integers(len(descriptions)))]
-        tokens = self.tokenizer.tokenize(
-            description, self.text_len, truncate_text=self.truncate_captions
-        )[0]
-        img = _load_image(self.image_files[key])
-        arr = random_resized_crop(img, self.image_size, rng,
-                                  scale=(self.resize_ratio, 1.0))
-        return tokens, arr
+        try:
+            return make_pair(
+                self.text_files[key].read_text(),
+                lambda: _load_image(self.image_files[key]),
+                self.tokenizer, self.text_len, self.truncate_captions,
+                self.image_size, self.resize_ratio, rng)
+        except ValueError as e:
+            if "empty caption text" in str(e):
+                raise ValueError(
+                    f"empty caption file {self.text_files[key]}") from None
+            raise
 
     def item(self, idx: int, epoch: int):
         # fresh per-call Generator: numpy Generators are not thread-safe and

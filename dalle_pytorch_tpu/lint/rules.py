@@ -288,7 +288,11 @@ def rule_exc001(ctx: FileCtx) -> Iterator[RuleHit]:
 
 # --- CKPT001: raw durable-state writes outside the atomic helpers --------
 
-_CKPT_TOKENS = ("ckpt", "checkpoint", "heartbeat", "manifest")
+# "shard"/"index" cover the streaming shard sets (data/stream.py): the
+# shard index IS a manifest — a torn index.json makes the whole corpus
+# unreadable — so raw writes to shard-ish targets route through the same
+# atomic helpers (helpers.atomic_write_json / temp + os.replace).
+_CKPT_TOKENS = ("ckpt", "checkpoint", "heartbeat", "manifest", "shard")
 _WRITE_MODE_CHARS = "wax"
 
 

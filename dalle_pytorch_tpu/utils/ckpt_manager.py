@@ -27,7 +27,22 @@ file/dir name), ``files`` (per-file size + crc32, verified on scan), and
 
 Fault injection (``GRAFT_FAULTS``, see ``utils/faults.py``) threads through
 ``save`` at the ``ckpt_write`` site so the retry and fallback paths are
-rehearsed by tests instead of discovered by the first real preemption.
+rehearsed by tests instead of discovered by the first real preemption, and
+at the ``ckpt_async`` site (between data write and manifest publish) so the
+async writer's crash window is rehearsed too.
+
+**Async saves** (``async_save=True``, the trainers' default for msgpack
+payloads): the caller snapshots device arrays to host synchronously
+(``host_fetch``), then ``save`` hands the host payload to a background
+writer thread and returns immediately — the step loop no longer stalls on
+serialization + disk + crc for the whole checkpoint.  Nothing about the
+commit protocol changes: the SAME ``_save_once`` runs on the worker, the
+manifest publish remains the single commit point, and a crash anywhere
+before it leaves a manifest-less directory that ``latest_valid`` skips
+(invariants I1–I3, DESIGN.md §8, hold unchanged — proven by pointing the
+existing GRAFT_FAULTS torn-write/SIGTERM harness at the async path).  One
+save in flight at a time; Orbax sharded saves stay synchronous (they are
+collective across processes).
 """
 from __future__ import annotations
 
@@ -38,6 +53,7 @@ import re
 import shutil
 import sys
 import tempfile
+import threading
 import time
 import zlib
 from pathlib import Path
@@ -162,7 +178,8 @@ class CheckpointManager:
     def __init__(self, run_dir, prefix: str = "ckpt", keep_last: int = 3,
                  keep_every: int = 0, retries: int = 3,
                  backoff: float = 0.25, sharded: bool = False,
-                 fingerprint: Optional[str] = None):
+                 fingerprint: Optional[str] = None,
+                 async_save: bool = False):
         self.run_dir = Path(run_dir)
         self.prefix = prefix
         self.keep_last = int(keep_last)
@@ -171,6 +188,14 @@ class CheckpointManager:
         self.backoff = float(backoff)
         self.sharded = bool(sharded)
         self.fingerprint = fingerprint
+        # async saves write from a background thread (one in flight; the
+        # manifest publish stays the sole commit point).  Orbax sharded
+        # saves are COLLECTIVE — every process joins them — and collectives
+        # from an unsynchronized background thread can interleave across
+        # hosts, so sharded saves stay synchronous by construction.
+        self.async_save = bool(async_save) and not self.sharded
+        self._worker: Optional["threading.Thread"] = None
+        self.last_error: Optional[BaseException] = None
 
     # --- paths ---
 
@@ -190,11 +215,68 @@ class CheckpointManager:
 
     # --- write side ---
 
-    def save(self, step: int, payload: dict) -> Path:
-        """Write checkpoint ``step``; returns the payload path.  Transient
-        ``OSError``s (including injected ones) retry with exponential
-        backoff; a step that already has a *valid* manifest is a no-op (the
-        interrupt path may land on a step the cadence just saved)."""
+    def save(self, step: int, payload: dict) -> Optional[Path]:
+        """Write checkpoint ``step``.  Transient ``OSError``s (including
+        injected ones) retry with exponential backoff; a step that already
+        has a *valid* manifest is a no-op (the interrupt path may land on a
+        step the cadence just saved).
+
+        Synchronous mode returns the payload path.  With ``async_save``
+        the caller must hand in a payload that is already HOST data (the
+        trainers' ``host_fetch`` is the synchronous device→host snapshot);
+        serialization, file writes, the crc pass, the manifest publish and
+        retention all run on a background thread and ``save`` returns
+        ``None`` immediately — the step loop's stall per checkpoint is the
+        snapshot, not the write.  At most ONE save is in flight: a second
+        ``save`` first joins the previous one, so checkpoints can never
+        commit out of order and a cadence that outpaces the disk degrades
+        to the blocking behavior instead of queueing unboundedly.  A
+        background failure is recorded in ``last_error`` and logged —
+        same log-not-fatal contract as the trainers' managed saves — and
+        the NEXT checkpoint cadence writes the next one."""
+        if self.async_save:
+            self.wait()
+            worker = threading.Thread(
+                target=self._save_bg, args=(step, payload),
+                name=f"ckpt-async-{step}", daemon=True)
+            self._worker = worker
+            worker.start()
+            return None
+        return self._save_blocking(step, payload)
+
+    def _save_bg(self, step: int, payload: dict) -> None:
+        try:
+            self._save_blocking(step, payload)
+        # graftlint: disable=EXC001 (background writer: the error is recorded in last_error, logged loudly, and the next cadence save proceeds — the log-not-fatal managed-save contract)
+        except BaseException as e:  # noqa: BLE001
+            self.last_error = e
+            print(f"[ckpt] async save step {step} failed: {e}",
+                  file=sys.stderr, flush=True)
+
+    def wait(self) -> None:
+        """Join the in-flight async save, if any.  Callers that must see a
+        committed checkpoint before proceeding (the trainers' interrupt
+        path, process exit) call this; a recorded background failure stays
+        in ``last_error`` for inspection."""
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            worker.join()
+
+    @property
+    def in_flight(self) -> bool:
+        return self._worker is not None and self._worker.is_alive()
+
+    def finish(self) -> None:
+        """End-of-run barrier: join the writer and surface (log) any
+        recorded background failure.  Never raises — by the time a trainer
+        calls this it is exiting, and the on-disk state is whatever the
+        commit protocol made durable."""
+        self.wait()
+        if self.last_error is not None:
+            print(f"[ckpt] note: an async save failed earlier: "
+                  f"{self.last_error}", file=sys.stderr, flush=True)
+
+    def _save_blocking(self, step: int, payload: dict) -> Path:
         existing = verify(self._dir_for(step))
         if existing is not None:
             return existing.payload
@@ -239,6 +321,16 @@ class CheckpointManager:
                     "config_fingerprint": self.fingerprint,
                     "payload": data.name, "files": files,
                     "time": time.time()}
+        # faultpoint: GRAFT_FAULTS="ckpt_async:at_step=N" kills the writer
+        # HERE — data fully on disk, manifest never published.  This is the
+        # I1 crash window the commit protocol exists for: the directory is
+        # a torn write by definition and latest_valid() must fall back to
+        # the previous checkpoint.  InjectedKill is not an OSError, so the
+        # retry loop does NOT heal it — the save dies, as a real kill would.
+        if "at_step" in faults.fire("ckpt_async", step=step):
+            raise faults.InjectedKill(
+                f"injected kill between data write and manifest publish "
+                f"of step {step}")
         self._publish_manifest(cdir, manifest)
         self._apply_retention()
         return data

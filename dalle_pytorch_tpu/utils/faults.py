@@ -36,6 +36,19 @@ real on-device gradients; a large finite factor lands a genuine spike);
 the hung-step watchdog's armed window so its kill-and-relaunch path is
 rehearsed end to end.
 
+Streaming-ingestion site (data/stream.py): ``shard_read`` is hit once per
+shard sample-read attempt.  ``fail_after``/``every`` model transient shard
+I/O (retried once, then the SHARD is quarantined — logged, capped);
+``truncate=N`` hands the reader a half-read image member (torn shard
+bytes), which must end in the same retry/quarantine path.
+
+Async-checkpoint site (utils/ckpt_manager.py): ``ckpt_async`` fires
+between the checkpoint's data write and its manifest publish, with
+``step`` = the checkpoint step.  ``at_step=N`` raises
+:class:`InjectedKill` there — the background writer dies with the data on
+disk and the commit record absent, the exact crash window invariant I1
+exists for (`latest_valid()` must fall back to the previous checkpoint).
+
 Serving site (serve/scheduler.py): ``serve_request`` is hit once per
 occupied slot per decode tick (slot order; ``step`` carries the request's
 decoded-token count, so ``at_step`` can target a progress milestone).  An
@@ -62,6 +75,16 @@ _ACTIONS = ("fail_after", "every", "truncate", "at_step")
 
 class InjectedFault(OSError):
     """A deliberately injected transient I/O failure (``GRAFT_FAULTS``)."""
+
+
+class InjectedKill(RuntimeError):
+    """A deliberately injected *process death* at a faultpoint — unlike
+    :class:`InjectedFault` it is NOT an ``OSError``, so retry loops that
+    model transient I/O (``CheckpointManager.save``) let it escape: the
+    code after the faultpoint never runs, exactly as if the scheduler had
+    killed the process there.  The ``ckpt_async`` site uses it to abandon
+    an async checkpoint between its data write and its manifest publish
+    (the I1 crash window: data on disk, commit record absent)."""
 
 
 @dataclasses.dataclass

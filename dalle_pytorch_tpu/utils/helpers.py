@@ -30,6 +30,38 @@ def env_flag(name: str, default: bool = False) -> bool:
     return val.strip().lower() not in ("", "0", "false", "no", "off")
 
 
+def atomic_write_bytes(path, data: bytes) -> None:
+    """Write ``data`` whole-or-not-at-all: temp file + fsync +
+    ``os.replace`` (the I1 discipline of DESIGN.md §8 — a reader can see
+    the old file or the new file, never a torn one).  Durable-state writes
+    outside ``utils/`` must route through here or the checkpoint helpers
+    (graftlint CKPT001)."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent),
+                               prefix=f".{path.name}-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def atomic_write_json(path, obj, indent: int = 1) -> None:
+    """:func:`atomic_write_bytes` of a JSON document."""
+    import json
+
+    atomic_write_bytes(path, json.dumps(obj, indent=indent).encode())
+
+
 def exists(val):
     return val is not None
 

@@ -155,10 +155,17 @@ def compiled_cost_summary(fn, *args, donate_argnums=(),
 
 
 class StepTimer:
-    """Wall-clock step timer with EMA, images/sec and MFU reporting.
+    """Wall-clock step timer with EMA, images/sec, MFU and loader-stall
+    reporting.
 
     Call ``tick(batch)`` once per completed (synced) step.  MFU uses the
-    analytic `flops_per_sample` when provided.
+    analytic `flops_per_sample` when provided.  ``stall_s`` is the host
+    time the step loop spent waiting on the input pipeline for this step
+    (``DevicePrefetcher.last_wait_s``): the reported EMA and
+    ``loader_stall_frac`` (stall over step time) make an *input-bound* run
+    readable as such in monitor/bench output instead of masquerading as a
+    slow chip — at ~0 the step is device-bound, near 1 the chip is idling
+    on the loader.
     """
 
     def __init__(self, flops_per_step: Optional[float] = None,
@@ -166,11 +173,12 @@ class StepTimer:
         self.flops_per_step = flops_per_step
         self.ema = ema
         self.avg_dt: Optional[float] = None
+        self.avg_stall: Optional[float] = None
         self._last: Optional[float] = None
         # flops_per_step covers the global batch, so peak spans all chips
         self.peak = device_peak_flops() * max(1, jax.device_count())
 
-    def tick(self, batch: int = 1) -> dict:
+    def tick(self, batch: int = 1, stall_s: Optional[float] = None) -> dict:
         now = time.perf_counter()
         out: dict = {}
         if self._last is not None:
@@ -181,6 +189,13 @@ class StepTimer:
             out["images_per_sec"] = batch / self.avg_dt
             if self.flops_per_step:
                 out["mfu"] = self.flops_per_step / self.avg_dt / self.peak
+            if stall_s is not None:
+                self.avg_stall = (stall_s if self.avg_stall is None
+                                  else self.ema * self.avg_stall
+                                  + (1 - self.ema) * stall_s)
+                out["loader_stall_s"] = self.avg_stall
+                out["loader_stall_frac"] = min(
+                    self.avg_stall / self.avg_dt, 1.0)
         self._last = now
         return out
 

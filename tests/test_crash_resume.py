@@ -275,3 +275,137 @@ def test_corrupt_sample_does_not_kill_training(baseline, tiny_dataset,
     run_train(wd, data, tiny_vae_ckpt, tiny_tokenizer_json, [], epochs=1)
     assert (wd / "dalle-final.pt").exists()
     assert "quarantining sample sample_5" in capsys.readouterr().out
+
+
+# --- streaming (--data_format shards) + async checkpointing ---------------
+
+
+@pytest.fixture(scope="module")
+def tiny_shards(tiny_dataset, tmp_path_factory):
+    """The tiny paired dataset as a 3-shard tar set (5+5+2 samples)."""
+    from dalle_pytorch_tpu.data import stream
+
+    out = tmp_path_factory.mktemp("shards")
+    stream.build_shards(tiny_dataset, out, samples_per_shard=5)
+    return out
+
+
+@pytest.fixture(scope="module")
+def baseline_shards(tiny_shards, tiny_vae_ckpt, tiny_tokenizer_json,
+                    tmp_path_factory):
+    wd = tmp_path_factory.mktemp("baseline_shards")
+    run_train(wd, tiny_shards, tiny_vae_ckpt, tiny_tokenizer_json,
+              ["--data_format", "shards"])
+    return wd
+
+
+def test_streaming_run_bitwise_equals_folder_run(baseline, baseline_shards):
+    """End-to-end cross-format identity: a full --data_format shards run
+    produces the SAME final weights/optimizer/rng/logs as the folder run —
+    the storage layer changed, the training run did not."""
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    base = load_checkpoint(baseline / "dalle-final.pt")
+    shrd = load_checkpoint(baseline_shards / "dalle-final.pt")
+    for key in ("weights", "opt_state"):
+        for b, r in zip(_leaves(base[key]), _leaves(shrd[key])):
+            np.testing.assert_array_equal(np.asarray(b), np.asarray(r))
+    assert list(base["rng"]) == list(shrd["rng"])
+    base_log, shrd_log = log_lines(baseline), log_lines(baseline_shards)
+    assert base_log == shrd_log
+
+
+def test_streaming_crash_resume_with_async_kill(baseline_shards, tiny_shards,
+                                                tiny_vae_ckpt,
+                                                tiny_tokenizer_json,
+                                                tmp_path_factory, capsys):
+    """The full async-checkpoint chaos scenario on the streaming pipeline:
+    SIGTERM at step 7 AND the async writer killed between the step-7
+    checkpoint's data write and its manifest publish.  The torn directory
+    must be invisible (I1: data present, no manifest), auto-resume must
+    fall back to step 4 (I2) and replay the rest of the run mid-shard,
+    bitwise (I3) — streaming cursor + async commit protocol together."""
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+    from dalle_pytorch_tpu.utils.ckpt_manager import MANIFEST, latest_valid
+
+    wd = tmp_path_factory.mktemp("shards_chaos")
+    run_train(wd, tiny_shards, tiny_vae_ckpt, tiny_tokenizer_json,
+              ["--data_format", "shards"],
+              faults_spec="sigterm:at_step=7,ckpt_async:at_step=7")
+    assert not (wd / "dalle-final.pt").exists()
+    torn = wd / "checkpoints" / "ckpt-00000007"
+    assert (torn / "data.msgpack").exists()     # data landed...
+    assert not (torn / MANIFEST).exists()       # ...but never committed
+    info = latest_valid(wd / "checkpoints")
+    assert info is not None and info.step == 4
+    # the step-4 cursor is mid-shard: cursor 1 of epoch 1's permutation
+    ckpt4 = load_checkpoint(info.payload)
+    loader_state = dict(ckpt4["loader"])
+    assert int(loader_state["cursor"]) == 1
+    assert int(loader_state["shard"]) >= 0
+
+    run_train(wd, tiny_shards, None, tiny_tokenizer_json,
+              ["--data_format", "shards", "--resume", "auto"])
+    assert "auto-resume: step 4" in capsys.readouterr().out
+    base = load_checkpoint(baseline_shards / "dalle-final.pt")
+    resumed = load_checkpoint(wd / "dalle-final.pt")
+    for key in ("weights", "opt_state"):
+        b_leaves = [np.asarray(v) for v in _leaves(base[key])]
+        r_leaves = [np.asarray(v) for v in _leaves(resumed[key])]
+        assert len(b_leaves) == len(r_leaves)
+        for b, r in zip(b_leaves, r_leaves):
+            np.testing.assert_array_equal(b, r)
+    assert list(base["rng"]) == list(resumed["rng"])
+    assert dict(base["loader"]) == dict(resumed["loader"])
+    base_log, resumed_log = log_lines(baseline_shards), log_lines(wd)
+    assert resumed_log and all(base_log.get(k) == line
+                               for k, line in resumed_log.items())
+
+
+def test_vae_streaming_sigterm_resume_bitwise(tiny_dataset, tmp_path_factory,
+                                              capsys):
+    """train_vae on image-only shards: preempted mid-shard, --resume auto
+    reproduces the uninterrupted run's final weights/optimizer bitwise."""
+    import train_vae
+    from dalle_pytorch_tpu.data import stream
+    from dalle_pytorch_tpu.utils import faults as faults_mod
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    shards = tmp_path_factory.mktemp("vae_shards")
+    stream.build_shards(tiny_dataset, shards, samples_per_shard=5,
+                        image_only=True)
+    hparams = dict(EPOCHS=2, BATCH_SIZE=4, NUM_TOKENS=32, NUM_LAYERS=2,
+                   NUM_RESNET_BLOCKS=0, EMB_DIM=16, HID_DIM=16)
+    args = ["--image_folder", str(shards), "--data_format", "shards",
+            "--image_size", "16", "--ckpt_every", "2"]
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps(hparams)
+    cwd = os.getcwd()
+    base_wd = tmp_path_factory.mktemp("vae_shards_base")
+    chaos_wd = tmp_path_factory.mktemp("vae_shards_chaos")
+    try:
+        os.chdir(base_wd)
+        train_vae.main(list(args))
+        faults_mod.reset()
+
+        os.chdir(chaos_wd)
+        os.environ["GRAFT_FAULTS"] = "sigterm:at_step=4"
+        train_vae.main(list(args))
+        faults_mod.reset()
+        os.environ.pop("GRAFT_FAULTS")
+        assert not (chaos_wd / "vae-final.pt").exists()
+        train_vae.main(list(args) + ["--resume", "auto"])
+        faults_mod.reset()
+    finally:
+        os.chdir(cwd)
+        del os.environ["DALLE_TPU_HPARAMS"]
+        os.environ.pop("GRAFT_FAULTS", None)
+    assert "auto-resume: step 4" in capsys.readouterr().out
+    base = load_checkpoint(base_wd / "vae-final.pt")
+    resumed = load_checkpoint(chaos_wd / "vae-final.pt")
+    for key in ("weights", "opt_state"):
+        b_leaves = [np.asarray(v) for v in _leaves(base[key])]
+        r_leaves = [np.asarray(v) for v in _leaves(resumed[key])]
+        assert len(b_leaves) == len(r_leaves)
+        for b, r in zip(b_leaves, r_leaves):
+            np.testing.assert_array_equal(b, r)
+    assert list(base["rng"]) == list(resumed["rng"])

@@ -304,6 +304,24 @@ def test_ckpt001_utils_helpers_exempt():
                                 select=("CKPT001",))) == ["CKPT001"]
 
 
+def test_ckpt001_covers_shard_manifest_writes():
+    """The streaming shard sets (data/stream.py) are durable run state too:
+    a torn shard or shard-index write corrupts the whole corpus view, so
+    raw writes to shard-ish targets are in CKPT001's scope."""
+    src = """
+    from pathlib import Path
+    with open(shard_index_path, "w") as f:
+        f.write("{}")
+    Path(shard_dir / "shard-000001.tar").write_bytes(b"x")
+    """
+    found = lint(src, select=("CKPT001",), path="tools/make_x.py")
+    assert rules_of(found) == ["CKPT001"] * 2
+    # routing through the utils/ atomic helpers is the sanctioned path
+    clean = "atomic_write_json(shard_index_path, index)\n"
+    assert lint_source(clean, path="tools/make_x.py",
+                       select=("CKPT001",)) == []
+
+
 def test_ckpt001_pragma_with_reason_suppresses():
     src = ("open(ckpt_debug_dump, 'w').write('x')  "
            "# graftlint: disable=CKPT001 (debug dump, not durable run state)\n")

@@ -73,6 +73,29 @@ def test_step_timer():
     assert 0 < out["mfu"] < 1e6
 
 
+def test_step_timer_loader_stall():
+    """stall_s feeds the loader-stall EMA and the stall fraction — the
+    surface monitor/bench use to tell an input-bound run from a slow
+    chip.  Fraction is clamped to 1 (a stall can't exceed the step)."""
+    t = StepTimer()
+    t.tick(8, stall_s=0.0)
+    time.sleep(0.01)
+    out = t.tick(8, stall_s=0.004)
+    assert out["loader_stall_s"] > 0
+    assert 0 < out["loader_stall_frac"] <= 1.0
+    # without stall_s the stall keys stay absent (folder runs without the
+    # prefetcher keep their old reporting shape)
+    t2 = StepTimer()
+    t2.tick(4)
+    time.sleep(0.002)
+    assert "loader_stall_s" not in t2.tick(4)
+    # clamp: an absurd stall reading still reports a fraction <= 1
+    t3 = StepTimer()
+    t3.tick(4, stall_s=0.0)
+    time.sleep(0.002)
+    assert t3.tick(4, stall_s=10.0)["loader_stall_frac"] == 1.0
+
+
 def test_transformer_flops_terms():
     # attention term must dominate at long seq, ff at large dim
     long_seq = transformer_train_flops(dim=64, depth=1, seq_len=4096,

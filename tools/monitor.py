@@ -102,7 +102,10 @@ def scan(directory: Path, timeout: float, expect: int | None) -> int:
             done = bool(info.get("done"))
             age = now - info["time"]
             detail = f"step {info.get('step', '?')} age {age:.0f}s"
-            for key in ("loss", "grad_norm"):
+            # loader_stall_s rides every beat (DevicePrefetcher metering):
+            # an input-bound host reads as "stall 2.3" here instead of
+            # masquerading as a slow chip
+            for key in ("loss", "grad_norm", "loader_stall_s"):
                 if info.get(key) is not None:
                     detail += f" {key} {float(info[key]):.5g}"
             sick = _health_flag(info)

@@ -62,7 +62,17 @@ def parse_args(argv=None):
                        help='path to your partially trained DALL-E')
     parser.add_argument('--image_text_folder', type=str, required=True,
                         help='path to your folder of images and text for '
-                             'learning the DALL-E')
+                             'learning the DALL-E (with --data_format '
+                             'shards: the shard directory holding '
+                             'index.json + shard-*.tar, see '
+                             'tools/make_shards.py)')
+    parser.add_argument('--data_format', choices=('folder', 'shards'),
+                        default='folder',
+                        help="input pipeline: 'folder' lists loose files "
+                             "(the reference layout); 'shards' streams tar "
+                             "shards with per-host shard assignment and a "
+                             "fingerprinted resume cursor — same batches, "
+                             "bitwise, under the same seed")
     parser.add_argument('--truncate_captions', action='store_true',
                         help='Captions passed in which exceed the max token '
                              'length will be truncated if this is set.')
@@ -145,6 +155,16 @@ def parse_args(argv=None):
     parser.add_argument('--ckpt_every', type=int, default=100,
                         help='managed-checkpoint cadence in steps (0 '
                              'disables the CheckpointManager entirely)')
+    parser.add_argument('--ckpt_async', action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help='write managed checkpoints from a background '
+                             'thread (device arrays still snapshot to host '
+                             'synchronously; the atomic manifest publish '
+                             'stays the sole commit point, so the '
+                             'crash-consistency invariants are unchanged). '
+                             '--no-ckpt_async restores blocking saves; '
+                             'Orbax sharded saves are always blocking '
+                             '(collective)')
     parser.add_argument('--mesh_sp', type=int, default=1,
                         help='sequence-parallel ways: shard the sequence '
                              'over an sp mesh axis with exact ring/Ulysses '
@@ -299,7 +319,8 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     manager = (CheckpointManager(args.ckpt_dir,
                                  keep_last=args.keep_checkpoints,
                                  keep_every=args.keep_every,
-                                 sharded=args.sharded_checkpoints)
+                                 sharded=args.sharded_checkpoints,
+                                 async_save=args.ckpt_async)
                if args.ckpt_every > 0 else None)
     if args.resume == 'auto':
         info = manager.latest_valid() if manager is not None else None
@@ -412,27 +433,46 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     dalle_dense = (DALLE(_dc.replace(dalle_cfg, ring_axis=None, sp_size=1))
                    if sp_plan else dalle)
 
-    ds = TextImageDataset(
-        args.image_text_folder, tokenizer, text_len=TEXT_SEQ_LEN,
-        image_size=vae_geom.image_size, resize_ratio=args.resize_ratio,
-        truncate_captions=args.truncate_captions,
-    )
+    if args.data_format == 'shards':
+        # streaming ingestion: tar shards + index manifest, per-host shard
+        # assignment, the same iteration contract (data/stream.py)
+        from dalle_pytorch_tpu.data.stream import (ShardStreamDataset,
+                                                   StreamingDataLoader)
+
+        ds = ShardStreamDataset(
+            args.image_text_folder, tokenizer, text_len=TEXT_SEQ_LEN,
+            image_size=vae_geom.image_size, resize_ratio=args.resize_ratio,
+            truncate_captions=args.truncate_captions,
+        )
+        dl = StreamingDataLoader(
+            ds, BATCH_SIZE, shuffle=True, drop_last=True,
+            shard_num_hosts=jax.process_count(),
+            shard_index=jax.process_index(),
+        )
+    else:
+        ds = TextImageDataset(
+            args.image_text_folder, tokenizer, text_len=TEXT_SEQ_LEN,
+            image_size=vae_geom.image_size, resize_ratio=args.resize_ratio,
+            truncate_captions=args.truncate_captions,
+        )
+        dl = DataLoader(
+            ds, BATCH_SIZE, shuffle=True, drop_last=True,
+            shard_num_hosts=jax.process_count(),
+            shard_index=jax.process_index(),
+        )
     assert len(ds) > 0, 'dataset is empty'
     if distr_backend.is_root_worker():
         print(f'{len(ds)} image-text pairs found for training')
-    dl = DataLoader(
-        ds, BATCH_SIZE, shuffle=True, drop_last=True,
-        shard_num_hosts=jax.process_count(), shard_index=jax.process_index(),
-    )
     # exact mid-epoch resume: replay the interrupted epoch's permutation and
     # skip the batches already consumed.  A loader snapshot from an earlier
     # epoch (final/sweep checkpoints, written after the epoch-end step) just
-    # aligns the permutation stream and starts the epoch fresh.
+    # aligns the permutation stream and starts the epoch fresh.  The loaders
+    # coerce their own scalar types (the streaming cursor also carries the
+    # shard-list fingerprint, a string, which it validates itself).
     resume_cursor = 0
     if resume_loader is not None and \
             int(dict(resume_loader).get('epoch', -1)) == start_epoch:
-        dl.load_state_dict({k: int(v)
-                            for k, v in dict(resume_loader).items()})
+        dl.load_state_dict(dict(resume_loader))
         resume_cursor = min(int(dict(resume_loader).get('cursor', 0)),
                             len(dl))
     else:
@@ -710,6 +750,21 @@ def _main(argv, lr_scale=1.0, skip_past=None):
         rng = jnp.asarray(np.asarray([int(v) for v in resume_rng],
                                      dtype=np.uint32))
 
+    # device-prefetch double buffer (both data formats): batch k+1 is
+    # pulled, cast, and device-placed while step k runs, and the wrapper
+    # meters what the step loop actually waited on the input pipeline
+    # (loader_stall_s — ridden on heartbeats and the perf extras below).
+    # Checkpoints MUST record batches.state_dict(), not dl.state_dict():
+    # the loader's own cursor runs ahead by the prefetch depth, and a
+    # resume from it would skip a never-trained batch.
+    from dalle_pytorch_tpu.data.stream import DevicePrefetcher
+
+    def _place_batch(batch):
+        text, images = batch
+        return part.shard_batch((text.astype(np.int32), images))
+
+    batches = DevicePrefetcher(dl, place=_place_batch, depth=1)
+
     sched = ReduceLROnPlateau(
         LEARNING_RATE, factor=LR_DECAY_FACTOR, patience=LR_DECAY_PATIENCE,
         cooldown=LR_DECAY_COOLDOWN, min_lr=LR_DECAY_MIN)
@@ -760,7 +815,9 @@ def _main(argv, lr_scale=1.0, skip_past=None):
         checkpoint formats restore them without device state."""
         extras = {
             'rng': [int(v) for v in np.asarray(jax.device_get(rng))],
-            'loader': dl.state_dict(),
+            # the prefetcher's view: the cursor of the batch the step loop
+            # actually holds, not the loader's read-ahead position
+            'loader': batches.state_dict(),
             'global_step': int(global_step),
         }
         if epoch_losses:
@@ -889,7 +946,8 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                     # (process_allgather), which would kill the one-step deferral
                     avg_loss, stop_poll[0] = stopper.average_and_poll(
                         distr_backend, loss_dev)
-                    perf = timer.tick(BATCH_SIZE * jax.process_count())
+                    perf = timer.tick(BATCH_SIZE * jax.process_count(),
+                                      stall_s=batches.last_wait_s)
                     if monitor_h is None or np.isfinite(avg_loss):
                         # a sentinel-skipped step left params untouched; its
                         # NaN must not poison the plateau epoch mean either
@@ -917,7 +975,7 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                                 'grad_norm': monitor_h.last_grad_norm,
                                 'loss_history': monitor_h.history(),
                                 'epoch': epoch,
-                                'loader': dl.state_dict(),
+                                'loader': batches.state_dict(),
                                 'rng': [int(v) for v in
                                         np.asarray(jax.device_get(rng))],
                                 'config_fingerprint':
@@ -927,7 +985,8 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                         sid, max_rollbacks=args.max_rollbacks,
                         reason=monitor_h.rollback_reason or 'anomaly')
 
-                for i, (text, images) in enumerate(dl):
+                for i, ((text, images),
+                        (text_b, images_b)) in enumerate(batches):
                     # `it` is the TRUE batch index in this epoch's
                     # permutation: a mid-epoch resume skips the consumed
                     # batches, so `i` restarts at 0 while the cadences
@@ -966,7 +1025,6 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                         # previous step's host sync, periodic sample/save) —
                         # any of them can wedge inside a device call
                         watchdog.arm(global_step + 1)
-                    text_b, images_b = part.shard_batch((text.astype(np.int32), images))
                     rng, step_rng = jax.random.split(rng)
                     if health_on:
                         params, opt_state, loss, health_vec = train_step(
@@ -1023,8 +1081,12 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                         save_managed(global_step, epoch)
                     if heartbeat is not None:
                         # health extras ride every beat so tools/monitor.py
-                        # can flag a sick run without reading logs
+                        # can flag a sick run without reading logs; the
+                        # loader stall rides too, so an input-bound run is
+                        # visible in monitor output
                         heartbeat.beat(global_step, epoch=epoch, loss_iter=it,
+                                       loader_stall_s=round(
+                                           batches.last_wait_s, 4),
                                        **(monitor_h.beat_extras()
                                           if monitor_h is not None else {}))
                     if watchdog is not None:
@@ -1079,6 +1141,10 @@ def _main(argv, lr_scale=1.0, skip_past=None):
 
             completed = not interrupted
     finally:
+        if manager is not None:
+            # join the in-flight async checkpoint write: the process must
+            # not exit (or report resume state) with an uncommitted save
+            manager.finish()
         if watchdog is not None:
             watchdog.close()
         if heartbeat is not None:

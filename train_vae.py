@@ -45,7 +45,16 @@ def parse_args(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument('--image_folder', type=str, required=True,
                         help='path to your folder of images for learning the '
-                             'discrete VAE and its codebook')
+                             'discrete VAE and its codebook (with '
+                             '--data_format shards: the shard directory '
+                             'holding index.json + shard-*.tar)')
+    parser.add_argument('--data_format', choices=('folder', 'shards'),
+                        default='folder',
+                        help="input pipeline: 'folder' lists loose files; "
+                             "'shards' streams tar shards (tools/"
+                             "make_shards.py --image_only) with per-host "
+                             "shard assignment and a fingerprinted resume "
+                             "cursor")
     parser.add_argument('--image_size', type=int, required=False, default=128,
                         help='image size')
     parser.add_argument('--resume_path', type=str, default=None,
@@ -103,6 +112,14 @@ def parse_args(argv=None):
     parser.add_argument('--ckpt_every', type=int, default=100,
                         help='managed-checkpoint cadence in steps (0 '
                              'disables the CheckpointManager entirely)')
+    parser.add_argument('--ckpt_async', action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help='write managed checkpoints from a background '
+                             'thread (host snapshot stays synchronous; the '
+                             'atomic manifest publish stays the sole commit '
+                             'point). --no-ckpt_async restores blocking '
+                             'saves; Orbax sharded saves are always '
+                             'blocking (collective)')
     parser = distributed_utils.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
     if args.stall_timeout and not args.heartbeat_dir:
@@ -183,7 +200,8 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     manager = (CheckpointManager(args.ckpt_dir,
                                  keep_last=args.keep_checkpoints,
                                  keep_every=args.keep_every,
-                                 sharded=args.sharded_checkpoints)
+                                 sharded=args.sharded_checkpoints,
+                                 async_save=args.ckpt_async)
                if args.ckpt_every > 0 else None)
     if args.resume == 'auto':
         info = manager.latest_valid() if manager is not None else None
@@ -242,11 +260,26 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     if manager is not None:
         manager.fingerprint = config_fingerprint(cfg.to_dict())
 
-    ds = ImageFolderDataset(args.image_folder, image_size=IMAGE_SIZE)
-    dl = DataLoader(
-        ds, BATCH_SIZE, shuffle=True, drop_last=True,
-        shard_num_hosts=jax.process_count(), shard_index=jax.process_index(),
-    )
+    if args.data_format == 'shards':
+        # streaming ingestion (data/stream.py): image-only tar shards
+        # behind the same iteration contract
+        from dalle_pytorch_tpu.data.stream import (ShardStreamDataset,
+                                                   StreamingDataLoader)
+
+        ds = ShardStreamDataset(args.image_folder, image_size=IMAGE_SIZE,
+                                image_only=True)
+        dl = StreamingDataLoader(
+            ds, BATCH_SIZE, shuffle=True, drop_last=True,
+            shard_num_hosts=jax.process_count(),
+            shard_index=jax.process_index(),
+        )
+    else:
+        ds = ImageFolderDataset(args.image_folder, image_size=IMAGE_SIZE)
+        dl = DataLoader(
+            ds, BATCH_SIZE, shuffle=True, drop_last=True,
+            shard_num_hosts=jax.process_count(),
+            shard_index=jax.process_index(),
+        )
     assert len(ds) > 0, 'folder does not contain any images'
     if distr_backend.is_root_worker():
         print(f'{len(ds)} images found for training')
@@ -308,6 +341,14 @@ def _main(argv, lr_scale=1.0, skip_past=None):
         vae, tx, health=health_on,
         guard=args.health in ('skip', 'rollback'), partitioner=part)
 
+    # device-prefetch double buffer (both data formats): batch k+1 is
+    # pulled and device-placed while step k runs; checkpoints record
+    # batches.state_dict() (the consumed-batch cursor), never the loader's
+    # read-ahead cursor
+    from dalle_pytorch_tpu.data.stream import DevicePrefetcher
+
+    batches = DevicePrefetcher(dl, place=part.shard_batch, depth=1)
+
     sched = ExponentialDecay(LEARNING_RATE, LR_DECAY_RATE)
     temp_sched = GumbelTemperature(STARTING_TEMP, TEMP_MIN, ANNEAL_RATE)
     start_epoch = 0
@@ -327,8 +368,10 @@ def _main(argv, lr_scale=1.0, skip_past=None):
         resume_loader = resume_ckpt.get('loader')
         if resume_loader is not None and \
                 int(dict(resume_loader).get('epoch', -1)) == start_epoch:
-            dl.load_state_dict({k: int(v)
-                                for k, v in dict(resume_loader).items()})
+            # the loaders coerce their own scalar types (the streaming
+            # cursor also carries the shard-list fingerprint, a string,
+            # which it validates itself)
+            dl.load_state_dict(dict(resume_loader))
             resume_cursor = min(int(dict(resume_loader).get('cursor', 0)),
                                 len(dl))
         else:
@@ -370,7 +413,7 @@ def _main(argv, lr_scale=1.0, skip_past=None):
             'temperature': temp, 'lr': lr,
             # exact-resume extras (plain scalars; restore without devices)
             'rng': [int(v) for v in np.asarray(jax.device_get(rng))],
-            'loader': dl.state_dict(),
+            'loader': batches.state_dict(),
         }
 
     def save_vae_model(path, epoch):
@@ -457,7 +500,7 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                         'loss': monitor_h.last_loss,
                         'grad_norm': monitor_h.last_grad_norm,
                         'loss_history': monitor_h.history(),
-                        'loader': dl.state_dict(),
+                        'loader': batches.state_dict(),
                         'rng': [int(v) for v in
                                 np.asarray(jax.device_get(rng))],
                         'config_fingerprint':
@@ -471,7 +514,7 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     try:
         with stopper:
             for epoch in range(start_epoch, EPOCHS):
-                for i, images in enumerate(dl):
+                for i, (images, batch) in enumerate(batches):
                     # `it`: true batch index in this epoch's permutation —
                     # a mid-epoch resume skips consumed batches, so the
                     # cadences below must continue from the interrupted
@@ -488,7 +531,6 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                         continue
                     if watchdog is not None:
                         watchdog.arm(global_step + 1)
-                    batch = part.shard_batch(images)
                     rng, step_rng = jax.random.split(rng)
                     if health_on:
                         params, opt_state, loss, recons, health_vec = \
@@ -550,7 +592,10 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                             distr_backend, loss)
                         dt, t_step = time.perf_counter() - t_step, time.perf_counter()
                         logger.step(epoch, it, avg_loss, lr,
-                                    extra={'temperature': temp, 'sec_per_10steps': dt})
+                                    extra={'temperature': temp,
+                                           'sec_per_10steps': dt,
+                                           'loader_stall_s':
+                                               batches.last_wait_s})
                     global_step += 1
                     if args.ckpt_every > 0 and it % args.ckpt_every == 0:
                         # observe THIS step's health before it reaches a
@@ -562,6 +607,8 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                         save_vae_managed(global_step, epoch)
                     if heartbeat is not None:
                         heartbeat.beat(global_step, epoch=epoch,
+                                       loader_stall_s=round(
+                                           batches.last_wait_s, 4),
                                        **(monitor_h.beat_extras()
                                           if monitor_h is not None else {}))
                     if watchdog is not None:
@@ -590,6 +637,9 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                     break
             completed = not interrupted
     finally:
+        if manager is not None:
+            # join the in-flight async checkpoint write before exit
+            manager.finish()
         if watchdog is not None:
             watchdog.close()
         if heartbeat is not None:
