@@ -21,6 +21,7 @@ from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
+from ..obs import telemetry
 from ..utils import faults
 
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
@@ -180,8 +181,11 @@ class TextImageDataset:
         with self._quarantine_lock:
             self._quarantined.add(key)
             n = len(self._quarantined)
-        print(f"warning: quarantining sample {key} "
-              f"({n}/{self.max_quarantine} quarantined): {err}", flush=True)
+        telemetry.note(
+            "data", "sample_quarantine",
+            f"quarantining sample {key} "
+            f"({n}/{self.max_quarantine} quarantined): {err}",
+            prefix="warning:", stream="stdout", key=key, quarantined=n)
         if n > self.max_quarantine:
             raise RuntimeError(
                 f"TextImageDataset: {n} samples quarantined (cap "

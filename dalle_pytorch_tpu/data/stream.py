@@ -52,6 +52,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import telemetry
 from ..utils import faults
 from ..utils.helpers import atomic_write_json
 from .dataset import DataLoader, IMAGE_EXTS, center_crop_resize, make_pair
@@ -236,8 +237,11 @@ class ShardStreamDataset:
             self._quarantined.add(s)
             n = len(self._quarantined)
         name = self.index.shards[s]["name"]
-        print(f"warning: quarantining shard {name} "
-              f"({n}/{self.max_quarantine} quarantined): {err}", flush=True)
+        telemetry.note(
+            "data", "shard_quarantine",
+            f"quarantining shard {name} "
+            f"({n}/{self.max_quarantine} quarantined): {err}",
+            prefix="warning:", stream="stdout", shard=name, quarantined=n)
         if n > self.max_quarantine:
             raise RuntimeError(
                 f"ShardStreamDataset: {n} shards quarantined (cap "
@@ -431,10 +435,15 @@ class DevicePrefetcher:
     """
 
     def __init__(self, loader, place: Optional[Callable] = None,
-                 depth: int = 1):
+                 depth: int = 1, stall_event_s: float = 1.0):
         self.loader = loader
         self.place = place
         self.depth = max(0, int(depth))
+        # substantial stalls (>= stall_event_s of host wait for one batch)
+        # become discrete telemetry events; the continuous metric still
+        # rides every step record via last_wait_s, so this only marks the
+        # outliers an operator would want on the timeline
+        self.stall_event_s = float(stall_event_s)
         self._state: Optional[dict] = None
         self.last_wait_s = 0.0
         self.total_wait_s = 0.0
@@ -481,6 +490,9 @@ class DevicePrefetcher:
             self.last_wait_s, waited[0] = waited[0], 0.0
             self.total_wait_s += self.last_wait_s
             self.batches += 1
+            if self.last_wait_s >= self.stall_event_s:
+                telemetry.emit("data", "loader_stall",
+                               wait_s=self.last_wait_s, batch=self.batches)
             yield (batch, placed) if self.place is not None else batch
             pull()
 

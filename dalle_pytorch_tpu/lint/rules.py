@@ -355,6 +355,46 @@ def rule_ckpt001(ctx: FileCtx) -> Iterator[RuleHit]:
             yield node, msg.format(label)
 
 
+# --- OBS001: bare print() in step/serve/ckpt hot paths --------------------
+
+# package subtrees whose narration must reach the telemetry stream: the
+# step/serve/ckpt/data hot paths every post-mortem replays.  models/ops/
+# parallel are pure computation (no narration), lint is host tooling, and
+# the sinks themselves (obs/, utils/logging.py's TrainLogger) are exempt —
+# a sink printing is the sink working.
+_OBS_HOT_SUBTREES = ("serve", "data", "utils")
+_OBS_HOT_FILES = ("training.py",)
+_OBS_EXEMPT = (("utils", "logging.py"),)
+
+
+def rule_obs001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """A bare ``print()`` in a hot path (step loop, serve scheduler,
+    checkpoint manager, data pipeline) narrates to a terminal nobody is
+    watching and to no one else: the BENCH rounds that died on a wedged
+    tunnel left NO attributable timeline because every layer logged this
+    way.  Operator messages in ``dalle_pytorch_tpu/``'s serve/data/utils
+    subtrees (and training.py) must go through ``obs.telemetry.note`` —
+    the stderr line AND the stream event in one call — or TrainLogger;
+    pragma with a reason where a raw print is genuinely correct (e.g. a
+    CLI-only surface)."""
+    msg = ("bare print() in a step/serve/ckpt hot path leaves no record in "
+           "the run's telemetry stream; use dalle_pytorch_tpu.obs."
+           "telemetry.note (stderr line + stream event) or TrainLogger, or "
+           "pragma with why a raw print is correct here")
+    parts = tuple(ctx.path.replace("\\", "/").split("/"))
+    if "dalle_pytorch_tpu" not in parts:
+        return
+    sub = parts[parts.index("dalle_pytorch_tpu") + 1:]
+    if not sub or any(sub[-len(ex):] == ex for ex in _OBS_EXEMPT):
+        return
+    if sub[0] not in _OBS_HOT_SUBTREES and sub[-1] not in _OBS_HOT_FILES:
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "print":
+            yield node, msg
+
+
 # --- DON001/DON002: buffer donation (the AST side of graftspmd S2) --------
 
 _STEP_FACTORY_RE = re.compile(r"^make_\w*step\w*$")
@@ -539,6 +579,7 @@ RULES = {
     "TRACE001": rule_trace001,
     "EXC001": rule_exc001,
     "CKPT001": rule_ckpt001,
+    "OBS001": rule_obs001,
     "DON001": rule_don001,
     "DON002": rule_don002,
 }

@@ -1,0 +1,19 @@
+"""graftscope: unified run telemetry (DESIGN.md §14).
+
+``telemetry`` is the write side (run-scoped JSONL event stream + spans +
+the module-level singleton every layer emits into), ``report`` and
+``trace_export`` are the read side (run report, Perfetto/Chrome trace).
+Stdlib-only by design: the stream must be writable and readable on a box
+whose accelerator tunnel is wedged.
+"""
+from . import telemetry
+from .report import build_report, render_text
+from .telemetry import (EVENT_SCHEMA, SCHEMA_VERSION, Telemetry, emit, get,
+                        init, note, read_events, shutdown, span)
+from .trace_export import to_chrome_trace
+
+__all__ = [
+    "telemetry", "Telemetry", "EVENT_SCHEMA", "SCHEMA_VERSION",
+    "init", "get", "shutdown", "emit", "span", "note", "read_events",
+    "build_report", "render_text", "to_chrome_trace",
+]

@@ -1,0 +1,293 @@
+"""Run-report aggregation over a telemetry stream (tools/obs_report.py).
+
+Turns the raw event stream into the answers an operator actually asks
+after (or during) a run: where did the time go (step-time/MFU/stall
+trajectory + the StepTimer reservoir percentiles), was it healthy (verdict
+timeline, rollbacks, watchdog fires), did the checkpoints keep up (publish
+cadence, save durations, fallbacks), how did serving do (p50/p99 latency
+per SLO class, attainment, preemptions), and what was injected or broke
+(fault + quarantine events).  Stdlib-only, like the rest of ``obs`` — it
+must run on the box whose accelerator just wedged.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def _pct(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile, stdlib-only (no numpy on the read side)."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(int(round((q / 100.0) * (len(ordered) - 1))), len(ordered) - 1)
+    return float(ordered[idx])
+
+
+def _span_pairs(events: List[dict]) -> List[dict]:
+    """Matched span pairs as merged dicts (B fields + dur_s/ok from E)."""
+    begins = {(r.get("host", 0), r.get("seq")): r
+              for r in events if r.get("ph") == "B"}
+    out = []
+    for r in events:
+        if r.get("ph") != "E":
+            continue
+        b = begins.pop((r.get("host", 0), r.get("sid")), None)
+        if b is not None:
+            merged = dict(b)
+            merged.update(dur_s=r.get("dur_s"), ok=r.get("ok", True))
+            out.append(merged)
+    # whatever stayed in `begins` is a torn span (death inside it)
+    out.sort(key=lambda r: (r.get("host", 0), r.get("seq", 0)))
+    return out
+
+
+def _torn_spans(events: List[dict]) -> List[dict]:
+    ended = {(r.get("host", 0), r.get("sid")) for r in events
+             if r.get("ph") == "E"}
+    return [r for r in events if r.get("ph") == "B"
+            and (r.get("host", 0), r.get("seq")) not in ended]
+
+
+def build_report(events: List[dict]) -> dict:
+    """Aggregate parsed records (telemetry.read_events output) into the
+    run-report dict ``render_text`` prints and ``--format json`` emits."""
+    by_kind: Dict[str, int] = {}
+    for r in events:
+        by_kind[r.get("kind", "?")] = by_kind.get(r.get("kind", "?"), 0) + 1
+
+    runs: Dict[str, dict] = {}
+    for r in events:
+        run = runs.setdefault(str(r.get("run", "?")), {
+            "hosts": set(), "t_first": None, "t_last": None, "records": 0})
+        run["hosts"].add(r.get("host", 0))
+        run["records"] += 1
+        t = r.get("t")
+        if t is not None:
+            run["t_first"] = t if run["t_first"] is None \
+                else min(run["t_first"], t)
+            run["t_last"] = t if run["t_last"] is None \
+                else max(run["t_last"], t)
+    for run in runs.values():
+        run["hosts"] = sorted(run["hosts"])
+        run["wall_s"] = (run["t_last"] - run["t_first"]
+                         if run["t_first"] is not None else None)
+
+    # --- steps ------------------------------------------------------------
+    steps = [r for r in events if r.get("kind") == "step" and "ph" not in r]
+    losses = [float(r["loss"]) for r in steps if r.get("loss") is not None]
+    step_report: dict = {"records": len(steps)}
+    if steps:
+        sids = [int(r["step"]) for r in steps if r.get("step") is not None]
+        step_report.update(
+            first_step=min(sids) if sids else None,
+            last_step=max(sids) if sids else None,
+            loss_first=losses[0] if losses else None,
+            loss_last=losses[-1] if losses else None,
+            loss_min=min(losses) if losses else None,
+            step_time_p50=_pct([float(r["step_time_s"]) for r in steps
+                                if r.get("step_time_s") is not None], 50),
+            mfu_last=next((float(r["mfu"]) for r in reversed(steps)
+                           if r.get("mfu") is not None), None),
+            stall_frac_mean=(lambda v: sum(v) / len(v) if v else None)(
+                [float(r["loader_stall_frac"]) for r in steps
+                 if r.get("loader_stall_frac") is not None]))
+    # the StepTimer reservoir percentiles ride run_end / perf_summary events
+    perf = [r for r in events if r.get("name") in ("perf_summary", "run_end")
+            and r.get("step_time_p50") is not None]
+    if perf:
+        step_report["reservoir"] = {
+            k: perf[-1].get(k) for k in ("step_time_p50", "step_time_p99",
+                                         "stall_p50", "stall_p99",
+                                         "reservoir_n")
+            if perf[-1].get(k) is not None}
+
+    # --- health -----------------------------------------------------------
+    health = [r for r in events if r.get("kind") == "health"]
+    verdicts: Dict[str, int] = {}
+    for r in health:
+        verdicts[r.get("name", "?")] = verdicts.get(r.get("name", "?"), 0) + 1
+    health_report = {
+        "verdicts": verdicts,
+        "timeline": [{"step": r.get("step"), "name": r.get("name"),
+                      "loss": r.get("loss"), "host": r.get("host", 0)}
+                     for r in health
+                     if r.get("name") not in ("ok",)][:50],
+    }
+
+    # --- checkpoints --------------------------------------------------------
+    ckpt = [r for r in events if r.get("kind") == "ckpt"]
+    publishes = [r for r in ckpt if r.get("name") == "publish"]
+    pub_steps = sorted(int(r["step"]) for r in publishes
+                       if r.get("step") is not None)
+    pub_times = sorted(float(r["t"]) for r in publishes if r.get("t"))
+    save_spans = [r for r in _span_pairs(ckpt) if r.get("name") == "save"]
+    ckpt_report = {
+        "publishes": len(publishes),
+        "publish_steps": pub_steps[-20:],
+        "cadence_s": ((pub_times[-1] - pub_times[0]) / (len(pub_times) - 1)
+                      if len(pub_times) > 1 else None),
+        "save_dur_p50": _pct([float(r["dur_s"]) for r in save_spans
+                              if r.get("dur_s") is not None], 50),
+        "save_dur_max": max((float(r["dur_s"]) for r in save_spans
+                             if r.get("dur_s") is not None), default=None),
+        "fallback_skips": sum(r.get("name") == "fallback_skip" for r in ckpt),
+        "failed_saves": sum(r.get("name") == "save_failed" for r in ckpt),
+        "torn_saves": len([r for r in _torn_spans(ckpt)
+                           if r.get("name") == "save"]),
+    }
+
+    # --- serve --------------------------------------------------------------
+    serve = [r for r in events if r.get("kind") == "serve"]
+    retires = [r for r in serve if r.get("name") == "retire"]
+    classes = sorted({str(r.get("slo")) for r in retires}) or []
+    per_class = {}
+    for slo in classes:
+        rows = [r for r in retires if str(r.get("slo")) == slo]
+        lat = [float(r["latency_s"]) for r in rows
+               if r.get("latency_s") is not None]
+        waits = [float(r["queue_wait_s"]) for r in rows
+                 if r.get("queue_wait_s") is not None]
+        judged = [r for r in rows if r.get("slo_ok") is not None]
+        per_class[slo] = {
+            "completed": len(rows),
+            "latency_p50": _pct(lat, 50), "latency_p99": _pct(lat, 99),
+            "queue_wait_mean": sum(waits) / len(waits) if waits else None,
+            "attainment": (sum(bool(r["slo_ok"]) for r in judged)
+                           / len(judged) if judged else None),
+        }
+    ticks = [r for r in serve if r.get("name") == "tick"]
+    serve_report = {
+        "submitted": sum(r.get("name") == "submit" for r in serve),
+        "completed": len(retires),
+        "failed": sum(r.get("name") == "fail" for r in serve),
+        "preemptions": sum(r.get("name") == "preempt" for r in serve),
+        "ticks": len(ticks),
+        "decoded_tokens": sum(int(r.get("tokens", 0)) for r in retires),
+        "by_class": per_class,
+    }
+
+    # --- faults / data ------------------------------------------------------
+    faults = [{"site": r.get("name"), "action": r.get("action"),
+               "step": r.get("step"), "hits": r.get("hits"),
+               "host": r.get("host", 0)}
+              for r in events if r.get("kind") == "fault"][:50]
+    data = [r for r in events if r.get("kind") == "data"]
+    data_report = {
+        "sample_quarantines": sum(r.get("name") == "sample_quarantine"
+                                  for r in data),
+        "shard_quarantines": sum(r.get("name") == "shard_quarantine"
+                                 for r in data),
+        "loader_stalls": sum(r.get("name") == "loader_stall" for r in data),
+    }
+
+    return {
+        "records": len(events),
+        "by_kind": by_kind,
+        "runs": runs,
+        "steps": step_report,
+        "health": health_report,
+        "ckpt": ckpt_report,
+        "serve": serve_report,
+        "faults": faults,
+        "data": data_report,
+        "torn_spans": [{"kind": r.get("kind"), "name": r.get("name"),
+                        "host": r.get("host", 0), "seq": r.get("seq")}
+                       for r in _torn_spans(events)][:20],
+    }
+
+
+def _fmt(v, nd: int = 4) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def render_text(report: dict) -> str:
+    """The human half: one screen answering "what happened to this run"."""
+    lines: List[str] = []
+    lines.append(f"== graftscope run report "
+                 f"({report['records']} records) ==")
+    for run_id, run in report["runs"].items():
+        lines.append(f"run {run_id}: hosts {run['hosts']}, "
+                     f"{run['records']} records, "
+                     f"wall {_fmt(run['wall_s'])}s")
+    lines.append("kinds: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(report["by_kind"].items())))
+
+    s = report["steps"]
+    lines.append("-- training --")
+    if s.get("records"):
+        lines.append(
+            f"steps {s.get('first_step')}..{s.get('last_step')} "
+            f"({s['records']} records): loss "
+            f"{_fmt(s.get('loss_first'))} -> {_fmt(s.get('loss_last'))} "
+            f"(min {_fmt(s.get('loss_min'))}), step_time p50 "
+            f"{_fmt(s.get('step_time_p50'))}s, mfu {_fmt(s.get('mfu_last'))},"
+            f" stall frac {_fmt(s.get('stall_frac_mean'))}")
+        res = s.get("reservoir")
+        if res:
+            lines.append(
+                f"reservoir (n={res.get('reservoir_n')}): step_time "
+                f"p50 {_fmt(res.get('step_time_p50'))}s / p99 "
+                f"{_fmt(res.get('step_time_p99'))}s, stall p50 "
+                f"{_fmt(res.get('stall_p50'))}s / p99 "
+                f"{_fmt(res.get('stall_p99'))}s")
+    else:
+        lines.append("no step records")
+
+    h = report["health"]
+    lines.append("-- health --")
+    if h["verdicts"]:
+        lines.append("verdicts: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(h["verdicts"].items())))
+        for t in h["timeline"][:10]:
+            lines.append(f"  step {t['step']} host {t['host']}: {t['name']} "
+                         f"(loss {_fmt(t['loss'])})")
+    else:
+        lines.append("no health events")
+
+    c = report["ckpt"]
+    lines.append("-- checkpoints --")
+    lines.append(
+        f"publishes {c['publishes']} (steps {c['publish_steps']}), cadence "
+        f"{_fmt(c['cadence_s'])}s, save dur p50 {_fmt(c['save_dur_p50'])}s "
+        f"max {_fmt(c['save_dur_max'])}s, fallback skips "
+        f"{c['fallback_skips']}, failed {c['failed_saves']}, torn "
+        f"{c['torn_saves']}")
+
+    sv = report["serve"]
+    lines.append("-- serve --")
+    if sv["submitted"] or sv["completed"]:
+        lines.append(
+            f"requests {sv['submitted']} submitted / {sv['completed']} "
+            f"completed / {sv['failed']} failed, preemptions "
+            f"{sv['preemptions']}, ticks {sv['ticks']}, tokens "
+            f"{sv['decoded_tokens']}")
+        for slo, row in sv["by_class"].items():
+            lines.append(
+                f"  {slo}: n={row['completed']} p50 "
+                f"{_fmt(row['latency_p50'])}s p99 {_fmt(row['latency_p99'])}s"
+                f" wait {_fmt(row['queue_wait_mean'])}s attainment "
+                f"{_fmt(row['attainment'])}")
+    else:
+        lines.append("no serve events")
+
+    if report["faults"]:
+        lines.append("-- injected faults --")
+        for f in report["faults"][:10]:
+            lines.append(f"  {f['site']}:{f['action']} step {f['step']} "
+                         f"(hit {f['hits']}, host {f['host']})")
+    d = report["data"]
+    if any(d.values()):
+        lines.append(f"-- data -- sample quarantines "
+                     f"{d['sample_quarantines']}, shard quarantines "
+                     f"{d['shard_quarantines']}, loader stalls "
+                     f"{d['loader_stalls']}")
+    if report["torn_spans"]:
+        lines.append("-- torn spans (death inside) --")
+        for t in report["torn_spans"][:10]:
+            lines.append(f"  {t['kind']}.{t['name']} host {t['host']} "
+                         f"seq {t['seq']}")
+    return "\n".join(lines) + "\n"

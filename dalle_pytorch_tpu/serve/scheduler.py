@@ -46,6 +46,7 @@ from typing import Deque, Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import telemetry
 from ..utils import faults
 from .engine import SlotArena
 
@@ -95,10 +96,15 @@ class GenerationServer:
 
     def __init__(self, dalle, variables, num_slots: int = 8, *,
                  filter_thres: float = 0.9, top_p: Optional[float] = None,
-                 seed: int = 0, time_fn=time.monotonic):
+                 seed: int = 0, time_fn=time.monotonic,
+                 slo_targets: Optional[Dict[str, float]] = None):
         self.arena = SlotArena(dalle, variables, num_slots,
                                filter_thres=filter_thres, top_p=top_p)
         self.num_slots = num_slots
+        # optional end-to-end latency targets (seconds) per SLO class:
+        # when set, each retirement records slo_ok and stats()/obs_report
+        # aggregate attainment per class
+        self.slo_targets = dict(slo_targets or {})
         self._time = time_fn
         self._seed = seed
         self._lock = threading.Lock()
@@ -141,6 +147,7 @@ class GenerationServer:
                      else np.asarray([self._seed, rid], np.uint32)),
                 submitted_at=self._time())
             self._queues[slo].append(handle)
+        telemetry.emit("serve", "submit", rid=rid, slo=slo)
         return handle
 
     # --- scheduler iteration ----------------------------------------------
@@ -212,17 +219,31 @@ class GenerationServer:
             run = self._running[slot]
             if run.done >= total:
                 codes = self.arena.fetch_codes(slot)
-                run.handle.finished_at = self._time()
+                h = run.handle
+                h.finished_at = self._time()
                 del self._running[slot]
                 self._free.append(slot)
-                self.completed.append(run.handle)
-                run.handle.future.set_result(codes)
+                self.completed.append(h)
+                target = self.slo_targets.get(h.slo)
+                telemetry.emit(
+                    "serve", "retire", rid=h.request_id, slot=slot,
+                    slo=h.slo, tokens=run.done, latency_s=h.latency,
+                    queue_wait_s=(h.admitted_at - h.submitted_at
+                                  if h.admitted_at is not None else None),
+                    decode_s=(h.finished_at - h.admitted_at
+                              if h.admitted_at is not None else None),
+                    preemptions=h.preemptions,
+                    slo_ok=(None if target is None or h.latency is None
+                            else bool(h.latency <= target)))
+                h.future.set_result(codes)
 
     def _fail(self, slot: int, exc: BaseException) -> None:
         run = self._running.pop(slot)
         self._free.append(slot)
         run.handle.finished_at = self._time()
         self.failed.append(run.handle)
+        telemetry.emit("serve", "fail", rid=run.handle.request_id, slot=slot,
+                       slo=run.handle.slo, tokens=run.done, error=repr(exc))
         run.handle.future.set_exception(exc)
 
     def _preempt_one_throughput(self) -> Optional[int]:
@@ -239,6 +260,9 @@ class GenerationServer:
         self._free.append(slot)
         run.handle.preemptions += 1
         self.preemption_count += 1
+        telemetry.emit("serve", "preempt", rid=run.handle.request_id,
+                       slot=slot, tokens=run.done,
+                       preemptions=run.handle.preemptions)
         with self._lock:
             self._queues[THROUGHPUT].appendleft(run.handle)
         return slot
@@ -262,14 +286,19 @@ class GenerationServer:
             self._admit(handle)
 
     def _admit(self, handle: ServeHandle) -> None:
-        first_logits, caches = self.arena.prefill(
-            jnp.asarray(handle.text))
+        with telemetry.span("serve", "prefill", rid=handle.request_id):
+            first_logits, caches = self.arena.prefill(
+                jnp.asarray(handle.text))
         slot = self._free.pop()
         # self._clock is the NEXT tick's number — it pins the slot's cache
         # rotation so every later tick writes the shared physical column
         self.arena.admit(slot, first_logits, caches, handle.key,
                          handle.temperature, self._clock)
         handle.admitted_at = self._time()
+        telemetry.emit("serve", "admit", rid=handle.request_id, slot=slot,
+                       slo=handle.slo,
+                       queue_wait_s=handle.admitted_at - handle.submitted_at,
+                       preemptions=handle.preemptions)
         self._running[slot] = _Running(handle=handle, done=1)
         self._decoded_tokens += 1  # admit samples the request's first code
 
@@ -302,6 +331,10 @@ class GenerationServer:
         self._ticks += 1
         self._occupied_slot_ticks += n
         self._decoded_tokens += n
+        # one record per decode tick (not per slot per tick): occupancy and
+        # clock phase land on the timeline without multiplying the stream
+        # by num_slots
+        telemetry.emit("serve", "tick", clock=self._clock - 1, active=n)
         return n
 
     # --- metrics ------------------------------------------------------------
@@ -322,6 +355,13 @@ class GenerationServer:
 
         tokens = (window_tokens if window_tokens is not None
                   else self._decoded_tokens)
+
+        def attainment(slo):
+            target = self.slo_targets.get(slo)
+            if target is None or not lat[slo]:
+                return None
+            return sum(v <= target for v in lat[slo]) / len(lat[slo])
+
         return dict(
             ticks=self._ticks,
             decoded_tokens=tokens,
@@ -335,6 +375,7 @@ class GenerationServer:
             preemptions=self.preemption_count,
             latency_p50={slo: pct(lat[slo], 50) for slo in SLO_CLASSES},
             latency_p99={slo: pct(lat[slo], 99) for slo in SLO_CLASSES},
+            slo_attainment={slo: attainment(slo) for slo in SLO_CLASSES},
             trace_counts=self.trace_counts(),
         )
 

@@ -51,7 +51,6 @@ import json
 import os
 import re
 import shutil
-import sys
 import tempfile
 import threading
 import time
@@ -60,6 +59,7 @@ from pathlib import Path
 from typing import Optional
 
 from . import faults
+from ..obs import telemetry
 from .checkpoint import (is_process_zero, save_checkpoint,
                          save_checkpoint_sharded)
 
@@ -123,8 +123,9 @@ def verify(directory: Path,
     mpath = directory / MANIFEST
 
     def bad(why: str) -> None:
-        print(f"[ckpt] skipping {directory.name}: {why}",
-              file=sys.stderr, flush=True)
+        telemetry.note("ckpt", "fallback_skip",
+                       f"skipping {directory.name}: {why}", prefix="[ckpt]",
+                       directory=directory.name)
 
     if not mpath.is_file():
         bad("no manifest (torn write — the save died before publishing)")
@@ -250,8 +251,9 @@ class CheckpointManager:
         # graftlint: disable=EXC001 (background writer: the error is recorded in last_error, logged loudly, and the next cadence save proceeds — the log-not-fatal managed-save contract)
         except BaseException as e:  # noqa: BLE001
             self.last_error = e
-            print(f"[ckpt] async save step {step} failed: {e}",
-                  file=sys.stderr, flush=True)
+            telemetry.note("ckpt", "save_failed",
+                           f"async save step {step} failed: {e}",
+                           prefix="[ckpt]", step=int(step))
 
     def wait(self) -> None:
         """Join the in-flight async save, if any.  Callers that must see a
@@ -273,24 +275,34 @@ class CheckpointManager:
         commit protocol made durable."""
         self.wait()
         if self.last_error is not None:
-            print(f"[ckpt] note: an async save failed earlier: "
-                  f"{self.last_error}", file=sys.stderr, flush=True)
+            telemetry.note("ckpt", "save_failed_earlier",
+                           f"note: an async save failed earlier: "
+                           f"{self.last_error}", prefix="[ckpt]")
 
     def _save_blocking(self, step: int, payload: dict) -> Path:
         existing = verify(self._dir_for(step))
         if existing is not None:
             return existing.payload
-        for attempt in range(self.retries + 1):
-            try:
-                return self._save_once(step, payload)
-            except OSError as e:
-                if attempt >= self.retries:
-                    raise
-                delay = self.backoff * (2 ** attempt)
-                print(f"[ckpt] save step {step} attempt {attempt + 1} "
-                      f"failed ({e}); retrying in {delay:.2f}s",
-                      file=sys.stderr, flush=True)
-                time.sleep(delay)
+        # the span runs on whichever thread executes the save — the step
+        # loop for blocking saves, the ckpt-async-N worker for async ones —
+        # so the Perfetto timeline shows where the write time actually went
+        with telemetry.span("ckpt", "save", step=int(step),
+                            sharded=self.sharded,
+                            mode="async" if threading.current_thread().name
+                            .startswith("ckpt-async") else "blocking"):
+            for attempt in range(self.retries + 1):
+                try:
+                    return self._save_once(step, payload)
+                except OSError as e:
+                    if attempt >= self.retries:
+                        raise
+                    delay = self.backoff * (2 ** attempt)
+                    telemetry.note(
+                        "ckpt", "save_retry",
+                        f"save step {step} attempt {attempt + 1} "
+                        f"failed ({e}); retrying in {delay:.2f}s",
+                        prefix="[ckpt]", step=int(step), attempt=attempt + 1)
+                    time.sleep(delay)
         raise AssertionError("unreachable")
 
     def _save_once(self, step: int, payload: dict) -> Path:
@@ -332,6 +344,9 @@ class CheckpointManager:
                 f"injected kill between data write and manifest publish "
                 f"of step {step}")
         self._publish_manifest(cdir, manifest)
+        telemetry.emit("ckpt", "publish", step=int(step),
+                       files=len(files),
+                       bytes=sum(m["size"] for m in files.values()))
         self._apply_retention()
         return data
 

@@ -39,6 +39,8 @@ from typing import Optional
 import jax
 import numpy as np
 
+from ..obs import telemetry
+
 
 class ExitCode(enum.IntEnum):
     """The process exit-code taxonomy — THE one place these numbers live.
@@ -101,9 +103,13 @@ class GracefulShutdown:
             signal.raise_signal(signum)
             return
         self._requested = True
-        print(f"[failure] received signal {signum}: will checkpoint and "
-              "stop at the next step boundary (send again to force-quit)",
-              file=sys.stderr, flush=True)
+        # note() is signal-safe here: Telemetry's lock is an RLock, so a
+        # handler interrupting the main thread mid-event still emits
+        telemetry.note(
+            "run", "preempt_signal",
+            f"received signal {signum}: will checkpoint and stop at the "
+            "next step boundary (send again to force-quit)",
+            prefix="[failure]", signum=int(signum))
 
     def _restore(self):
         for sig, prev in self._previous.items():
@@ -166,13 +172,21 @@ class GracefulShutdown:
 
 
 class Heartbeat:
-    """Per-process progress file + optional in-process stall watchdog."""
+    """Per-process progress file + optional in-process stall watchdog.
+
+    ``run_id`` (explicit, else inherited from the active telemetry) and the
+    telemetry stream's last-event sequence number ride every heartbeat
+    write, so an external monitor can correlate a stalled host with its
+    telemetry tail — not just *that* it stalled, but what it was doing
+    (``tools/monitor.py --telemetry-dir``)."""
 
     def __init__(self, directory, beat_interval: float = 15.0,
-                 stall_timeout: Optional[float] = None):
+                 stall_timeout: Optional[float] = None,
+                 run_id: Optional[str] = None):
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.path = self.dir / f"heartbeat-p{jax.process_index()}.json"
+        self.run_id = run_id
         self.beat_interval = float(beat_interval)
         self._sweep_stale_temps()
         # None until the first beat: the stretch from construction to step 1
@@ -205,7 +219,8 @@ class Heartbeat:
             return
         self._last_write = now
         self._write({"step": int(step), "time": time.time(),
-                     "process": jax.process_index(), **extra})
+                     "process": jax.process_index(),
+                     **self._correlation(), **extra})
 
     def _sweep_stale_temps(self) -> None:
         """A process killed inside ``_write`` (between mkstemp and the
@@ -240,9 +255,12 @@ class Heartbeat:
             age = time.monotonic() - self._last_beat
             if age > self._timeout and self._stalled_since is None:
                 self._stalled_since = time.monotonic()
-                print(f"[failure] possible stall: no training step for "
-                      f"{age:.0f}s (timeout {self._timeout:.0f}s) — a hung "
-                      "collective or device step?", file=sys.stderr, flush=True)
+                telemetry.note(
+                    "run", "stall_warning",
+                    f"possible stall: no training step for {age:.0f}s "
+                    f"(timeout {self._timeout:.0f}s) — a hung collective "
+                    "or device step?", prefix="[failure]",
+                    age_s=age, step=self._last_step)
 
     def close(self, done: bool = False) -> None:
         """Stop the watchdog.  ``done=True`` stamps the heartbeat file with a
@@ -257,7 +275,19 @@ class Heartbeat:
             self._thread = None
         if done:
             self._write({"step": self._last_step, "time": time.time(),
-                         "process": jax.process_index(), "done": True})
+                         "process": jax.process_index(),
+                         **self._correlation(), "done": True})
+
+    def _correlation(self) -> dict:
+        """run_id + telemetry last-seq fields for every heartbeat write."""
+        tel = telemetry.get()
+        out = {}
+        run_id = self.run_id or (tel.run_id if tel is not None else None)
+        if run_id is not None:
+            out["run_id"] = run_id
+        if tel is not None:
+            out["telemetry_seq"] = tel.seq
+        return out
 
     # --- external-monitor side ---
 

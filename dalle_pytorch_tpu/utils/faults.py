@@ -70,6 +70,8 @@ import signal
 import threading
 from typing import Dict, FrozenSet, List, Optional
 
+from ..obs import telemetry
+
 _ACTIONS = ("fail_after", "every", "truncate", "at_step")
 
 
@@ -143,11 +145,13 @@ class FaultRegistry:
                 if t.action == "fail_after":
                     if not t.fired and hits == t.value + 1:
                         t.fired = True
+                        _record(site, "fail_after", hits, step)
                         raise InjectedFault(
                             f"injected fault: {site} hit {hits} "
                             f"(fail_after={t.value})")
                 elif t.action == "every":
                     if t.value > 0 and hits % t.value == 0:
+                        _record(site, "every", hits, step)
                         raise InjectedFault(
                             f"injected fault: {site} hit {hits} "
                             f"(every={t.value})")
@@ -159,7 +163,17 @@ class FaultRegistry:
                     if not t.fired and step is not None and step == t.value:
                         t.fired = True
                         actions.add("at_step")
+            for action in actions:
+                _record(site, action, hits, step)
             return frozenset(actions)
+
+
+def _record(site: str, action: str, hits: int, step: Optional[int]) -> None:
+    """A TRIGGERED injection becomes a telemetry event, so chaos suites can
+    assert cause→recovery ordering from the stream alone (the untriggered
+    per-hit path emits nothing — ``fire`` runs per sample read and per
+    serve slot per tick)."""
+    telemetry.emit("fault", site, action=action, hits=hits, step=step)
 
 
 _registry: Optional[FaultRegistry] = None
@@ -220,11 +234,12 @@ def maybe_hang(step: int, cap: float = 3600.0) -> None:
     ends it; ``cap`` bounds the sleep so a test that forgot to arm a
     watchdog still terminates eventually."""
     if "at_step" in fire("step_hang", step=step):
-        import sys
         import time
 
-        print(f"[faults] step_hang: wedging the step loop at step {step}",
-              file=sys.stderr, flush=True)
+        telemetry.note(
+            "fault", "step_hang_wedged",
+            f"step_hang: wedging the step loop at step {step}",
+            prefix="[faults]", step=step)
         deadline = time.monotonic() + cap
         while time.monotonic() < deadline:
             time.sleep(0.5)

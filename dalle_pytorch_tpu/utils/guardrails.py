@@ -62,6 +62,7 @@ import jax.numpy as jnp
 import optax
 
 from . import faults
+from ..obs import telemetry
 from .failure import ExitCode
 
 # observed loss multiplier for the loss_spike faultpoint: big enough that
@@ -194,19 +195,24 @@ def run_with_rollback(run_fn, argv):
         except RollbackAndSkip as rb:
             rollbacks += 1
             if rollbacks > rb.max_rollbacks:
-                print(f"[guardrails] rollback budget exhausted "
-                      f"({rb.max_rollbacks}): aborting with exit code "
-                      f"{int(ExitCode.ROLLBACK_BUDGET)} — automatic "
-                      "recovery will not converge, a human must look at "
-                      "the anomaly bundles", file=sys.stderr, flush=True)
+                telemetry.note(
+                    "health", "rollback_budget",
+                    f"rollback budget exhausted ({rb.max_rollbacks}): "
+                    f"aborting with exit code "
+                    f"{int(ExitCode.ROLLBACK_BUDGET)} — automatic recovery "
+                    "will not converge, a human must look at the anomaly "
+                    "bundles", prefix="[guardrails]", step=rb.step)
                 sys.exit(int(ExitCode.ROLLBACK_BUDGET))
             lr_scale *= rb.lr_backoff
             skip_past = rb.step
             argv = argv_with_resume_auto(argv)
-            print(f"[guardrails] rollback {rollbacks}/{rb.max_rollbacks} "
-                  f"({rb.reason} at step {rb.step}): relaunching with "
-                  f"--resume auto, skipping data through step {rb.step}, "
-                  f"lr x{lr_scale:g}", file=sys.stderr, flush=True)
+            telemetry.note(
+                "health", "rollback",
+                f"rollback {rollbacks}/{rb.max_rollbacks} ({rb.reason} at "
+                f"step {rb.step}): relaunching with --resume auto, skipping "
+                f"data through step {rb.step}, lr x{lr_scale:g}",
+                prefix="[guardrails]", step=rb.step, reason=rb.reason,
+                rollbacks=rollbacks, lr_scale=lr_scale)
 
 
 class HealthMonitor:
@@ -308,9 +314,12 @@ class HealthMonitor:
                       "spike": f"robust z > {self.spike_zscore:g}",
                       "diverged": f"loss EMA > {self.divergence_factor:g}x "
                                   "its best"}[verdict]
-            print(f"[guardrails] step {step}: {verdict} — loss {loss:.6g} "
-                  f"grad_norm {grad_norm:.6g} ({detail})",
-                  file=sys.stderr, flush=True)
+            telemetry.note(
+                "health", verdict,
+                f"step {step}: {verdict} — loss {loss:.6g} "
+                f"grad_norm {grad_norm:.6g} ({detail})",
+                prefix="[guardrails]", step=int(step), loss=float(loss),
+                grad_norm=float(grad_norm))
         if self.mode == "rollback" and not self.wants_rollback:
             if verdict in ("spike", "diverged"):
                 self.wants_rollback = True
@@ -319,6 +328,9 @@ class HealthMonitor:
                 self.wants_rollback = True
                 self.rollback_reason = (
                     f"{self._nonfinite_run} consecutive non-finite steps")
+            if self.wants_rollback:
+                telemetry.emit("health", "rollback_wanted", step=int(step),
+                               reason=self.rollback_reason)
         return verdict
 
     # -- consumers --
@@ -361,8 +373,9 @@ def write_anomaly_bundle(directory, step: int, report: dict) -> Path:
 
         shutil.rmtree(tmp, ignore_errors=True)
         raise
-    print(f"[guardrails] anomaly bundle written to {final}",
-          file=sys.stderr, flush=True)
+    telemetry.note("health", "anomaly_bundle",
+                   f"anomaly bundle written to {final}",
+                   prefix="[guardrails]", step=int(step), path=str(final))
     return final
 
 
@@ -418,11 +431,16 @@ class StepWatchdog:
                 return
 
     def _expire(self, age: float) -> None:
-        print(f"[guardrails] hung step: step {self._step} exceeded the "
-              f"{self.deadline:g}s deadline ({age:.0f}s) — a wedged device "
-              f"call or collective.  Dumping all thread stacks and exiting "
-              f"{int(ExitCode.WEDGED)} (supervisors relaunch with "
-              "--resume auto).", file=sys.stderr, flush=True)
+        # emitted (and os.write-flushed) BEFORE the stack dump + _exit, so
+        # the stream's last record names the wedged step
+        telemetry.note(
+            "health", "watchdog_expired",
+            f"hung step: step {self._step} exceeded the "
+            f"{self.deadline:g}s deadline ({age:.0f}s) — a wedged device "
+            f"call or collective.  Dumping all thread stacks and exiting "
+            f"{int(ExitCode.WEDGED)} (supervisors relaunch with "
+            "--resume auto).", prefix="[guardrails]", step=self._step,
+            age_s=age, deadline_s=self.deadline)
         if self._on_expire is not None:
             self._on_expire()
             return

@@ -73,15 +73,21 @@ class TrainLogger:
             return
         if self._f is not None:
             self._f.write(f"{epoch} {it} {loss} {lr}\n")
+        if self.run is not None:
+            # the wandb stream logs EVERY step, extras included — `extra`
+            # carries the perf/health metrics (mfu, stall, health_state),
+            # and decimating them to the print cadence silently dropped
+            # 9/10 of the mfu/stall trajectory from the dashboard.  Only
+            # the stdout print and the file flush keep the print_every
+            # cadence (the reference's surface).
+            payload = {"epoch": epoch, "iter": it, "loss": loss, "lr": lr}
+            payload.update(extra or {})
+            self.run.log(payload)
         if it % self.print_every == 0:
             print(epoch, it, f"loss - {loss}")
             sys.stdout.flush()
             if self._f is not None:  # flush cadence of the reference (:393-394)
                 self._f.flush()
-            if self.run is not None:
-                payload = {"epoch": epoch, "iter": it, "loss": loss, "lr": lr}
-                payload.update(extra or {})
-                self.run.log(payload)
 
     def log(self, payload: dict):
         if self.is_root and self.run is not None:

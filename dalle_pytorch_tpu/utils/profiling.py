@@ -9,6 +9,7 @@ plus a `jax.profiler` trace context for deeper dives in XProf.
 from __future__ import annotations
 
 import contextlib
+import random
 import time
 from typing import Optional
 
@@ -166,17 +167,40 @@ class StepTimer:
     readable as such in monitor/bench output instead of masquerading as a
     slow chip — at ~0 the step is device-bound, near 1 the chip is idling
     on the loader.
+
+    Besides the EMAs (unchanged — the smooth "now" the logs show), raw
+    per-step samples feed a bounded uniform reservoir (Vitter's Algorithm
+    R, deterministic generator) so :meth:`percentiles` can report p50/p99
+    step time and stall over the WHOLE run in O(reservoir) memory — the
+    tail behavior EMAs structurally cannot show, consumed by
+    ``tools/obs_report.py`` via the run's ``perf_summary`` event.
     """
 
     def __init__(self, flops_per_step: Optional[float] = None,
-                 ema: float = 0.9):
+                 ema: float = 0.9, reservoir: int = 512):
         self.flops_per_step = flops_per_step
         self.ema = ema
         self.avg_dt: Optional[float] = None
         self.avg_stall: Optional[float] = None
         self._last: Optional[float] = None
+        self._res_cap = int(reservoir)
+        self._res_rng = random.Random(0x5eed)
+        self._dt_res: list = []
+        self._dt_n = 0
+        self._stall_res: list = []
+        self._stall_n = 0
         # flops_per_step covers the global batch, so peak spans all chips
         self.peak = device_peak_flops() * max(1, jax.device_count())
+
+    def _reservoir_add(self, res: list, n: int, value: float) -> None:
+        """Algorithm R: after n samples every one had cap/n odds of being
+        in the reservoir — percentiles cover the run, not just its tail."""
+        if len(res) < self._res_cap:
+            res.append(value)
+        else:
+            j = self._res_rng.randrange(n)
+            if j < self._res_cap:
+                res[j] = value
 
     def tick(self, batch: int = 1, stall_s: Optional[float] = None) -> dict:
         now = time.perf_counter()
@@ -185,6 +209,8 @@ class StepTimer:
             dt = now - self._last
             self.avg_dt = (dt if self.avg_dt is None
                            else self.ema * self.avg_dt + (1 - self.ema) * dt)
+            self._dt_n += 1
+            self._reservoir_add(self._dt_res, self._dt_n, dt)
             out["step_time_s"] = self.avg_dt
             out["images_per_sec"] = batch / self.avg_dt
             if self.flops_per_step:
@@ -193,10 +219,31 @@ class StepTimer:
                 self.avg_stall = (stall_s if self.avg_stall is None
                                   else self.ema * self.avg_stall
                                   + (1 - self.ema) * stall_s)
+                self._stall_n += 1
+                self._reservoir_add(self._stall_res, self._stall_n, stall_s)
                 out["loader_stall_s"] = self.avg_stall
                 out["loader_stall_frac"] = min(
                     self.avg_stall / self.avg_dt, 1.0)
         self._last = now
+        return out
+
+    def percentiles(self) -> dict:
+        """p50/p99 of raw step time and stall over the reservoir samples
+        (``reservoir_n`` = steps observed).  Empty dict before step 2."""
+        def pct(values, q):
+            ordered = sorted(values)
+            idx = min(int(round((q / 100.0) * (len(ordered) - 1))),
+                      len(ordered) - 1)
+            return ordered[idx]
+
+        out: dict = {}
+        if self._dt_res:
+            out["reservoir_n"] = self._dt_n
+            out["step_time_p50"] = pct(self._dt_res, 50)
+            out["step_time_p99"] = pct(self._dt_res, 99)
+        if self._stall_res:
+            out["stall_p50"] = pct(self._stall_res, 50)
+            out["stall_p99"] = pct(self._stall_res, 99)
         return out
 
 

@@ -328,6 +328,50 @@ def test_ckpt001_pragma_with_reason_suppresses():
     assert lint_source(src, path="train_x.py", select=("CKPT001",)) == []
 
 
+# --- OBS001 --------------------------------------------------------------
+
+
+def test_obs001_hot_path_prints_flagged():
+    """Bare prints in the step/serve/ckpt/data hot paths must route
+    through telemetry.note or TrainLogger — that print is the narration
+    the post-mortem stream needs."""
+    src = """
+    def save(step):
+        print(f"saving {step}")
+    print("module-level narration", flush=True)
+    """
+    for path in ("dalle_pytorch_tpu/utils/ckpt_manager.py",
+                 "dalle_pytorch_tpu/serve/scheduler.py",
+                 "dalle_pytorch_tpu/data/stream.py",
+                 "dalle_pytorch_tpu/training.py"):
+        assert rules_of(lint(src, select=("OBS001",),
+                             path=path)) == ["OBS001"] * 2, path
+
+
+def test_obs001_out_of_scope_paths_clean():
+    """Pure-computation subtrees, the sinks themselves, tools/ and code
+    outside the package keep their prints — the rule is scoped to the hot
+    paths whose narration the stream must carry."""
+    src = 'print("hello")\n'
+    for path in ("dalle_pytorch_tpu/models/dalle.py",
+                 "dalle_pytorch_tpu/ops/attention.py",
+                 "dalle_pytorch_tpu/obs/telemetry.py",
+                 "dalle_pytorch_tpu/utils/logging.py",
+                 "dalle_pytorch_tpu/lint/engine.py",
+                 "tools/monitor.py", "train_dalle.py"):
+        assert lint_source(src, select=("OBS001",), path=path) == [], path
+
+
+def test_obs001_note_and_pragma_clean():
+    src = """
+    from dalle_pytorch_tpu.obs import telemetry
+    telemetry.note("ckpt", "save_retry", "retrying", step=3)
+    print("cli surface")  # graftlint: disable=OBS001 (interactive CLI output, never a run's narration)
+    """
+    assert lint(src, select=("OBS001",),
+                path="dalle_pytorch_tpu/utils/ckpt_manager.py") == []
+
+
 # --- engine machinery ----------------------------------------------------
 
 
@@ -692,7 +736,7 @@ def test_every_rule_has_fixture_coverage():
     """Meta: the rule registry and this file stay in sync — adding a rule
     without positive-fixture coverage fails here."""
     covered = {"ENV001", "SEED001", "BACKEND001", "DOT001", "TRACE001",
-               "EXC001", "CKPT001", "DON001", "DON002"}
+               "EXC001", "CKPT001", "OBS001", "DON001", "DON002"}
     assert covered == set(RULES)
 
 

@@ -168,26 +168,39 @@ if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
   BABYSIT_STALL_TIMEOUT=${BABYSIT_STALL_TIMEOUT:-600}
   BABYSIT_POLL=${BABYSIT_POLL:-60}
   BABYSIT_STEP_DEADLINE=${BABYSIT_STEP_DEADLINE:-0}
+  # graftscope stream: the supervised run appends its events here, and on
+  # every death/stall the victim's last events land in train_run.log via
+  # obs_report --tail — a babysitter restart carries the previous run's
+  # final moments into its own report instead of discarding them
+  BABYSIT_TEL_DIR=${BABYSIT_TEL_DIR:-${CHIP_TMP}/train_tel}
   (
     restarts=0
     while :; do
       echo "$(date +%T) train supervisor: launch (restarts so far: $restarts/${BABYSIT_MAX_RESTARTS})"
       ${BABYSIT_TRAIN_CMD} --resume auto --heartbeat_dir "${BABYSIT_HB_DIR}" \
         --step_deadline "${BABYSIT_STEP_DEADLINE}" \
+        --telemetry_dir "${BABYSIT_TEL_DIR}" \
         >> "${CHIP_TMP}/train_run.log" 2>&1 &
       train_pid=$!
       while kill -0 "$train_pid" 2>/dev/null; do
         sleep "$BABYSIT_POLL"
         python tools/monitor.py "${BABYSIT_HB_DIR}" \
-          --timeout "${BABYSIT_STALL_TIMEOUT}" >/dev/null 2>&1
+          --timeout "${BABYSIT_STALL_TIMEOUT}" \
+          --telemetry-dir "${BABYSIT_TEL_DIR}" >/dev/null 2>&1
         if [ $? -eq 1 ]; then  # stalled (a done/healthy run exits 0)
           echo "$(date +%T) train supervisor: stalled heartbeats — killing $train_pid"
+          echo "$(date +%T) train supervisor: victim's last telemetry:"
+          python tools/obs_report.py "${BABYSIT_TEL_DIR}" --tail 8 2>/dev/null || true
           kill "$train_pid" 2>/dev/null; sleep 5
           kill -9 "$train_pid" 2>/dev/null
           break
         fi
       done
       wait "$train_pid"; rc=$?
+      if [ "$rc" -ne 0 ]; then
+        echo "$(date +%T) train supervisor: rc=$rc — victim's last telemetry:"
+        python tools/obs_report.py "${BABYSIT_TEL_DIR}" --tail 8 2>/dev/null || true
+      fi
       # a done-marked heartbeat means the run FINISHED — never relaunch it
       if grep -q '"done": true' "${BABYSIT_HB_DIR}"/heartbeat-p*.json 2>/dev/null; then
         echo "$(date +%T) train supervisor: run completed"; break

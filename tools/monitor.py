@@ -28,9 +28,15 @@ scan prints them, flagging non-finite values and non-``ok`` verdicts with
 an ``UNHEALTHY`` marker — an operator sees a sick run here without
 reading training logs.
 
+Heartbeats carry ``run_id`` + ``telemetry_seq`` (the graftscope stream's
+last event number); with ``--telemetry-dir`` a STALLED host's scan line is
+followed by its last few telemetry records — what the run was *doing*
+when it went quiet, not just that it did.
+
 Usage:
     python tools/monitor.py HEARTBEAT_DIR [--timeout 300] [--expect N] [--watch S]
     python tools/monitor.py hb --watch 60 --ckpt-dir checkpoints \
+        --telemetry-dir tel \
         --restart-cmd 'nohup python train_dalle.py --resume auto ... &'
 
 Exit codes (the ``ExitCode`` taxonomy in utils/failure.py): 0 all hosts
@@ -77,7 +83,30 @@ def _health_flag(info: dict) -> str | None:
     return " ".join(bits) or None
 
 
-def scan(directory: Path, timeout: float, expect: int | None) -> int:
+def _telemetry_tail(telemetry_dir: Path, proc: int, run_id: str | None,
+                    n: int = 5) -> list[str]:
+    """The last ``n`` telemetry records for host ``proc`` (of ``run_id``
+    when the heartbeat named one) — the "what was it doing when it
+    stalled" answer, printed under a STALLED host's line."""
+    from dalle_pytorch_tpu.obs.telemetry import read_events
+
+    try:
+        events = read_events(telemetry_dir)
+    except OSError:
+        return []
+    rows = [r for r in events if r.get("host", 0) == proc
+            and (run_id is None or r.get("run") == run_id)]
+    out = []
+    for r in rows[-n:]:
+        bits = " ".join(f"{k}={r[k]}" for k in ("step", "ph", "msg")
+                        if r.get(k) is not None)
+        out.append(f"    seq {r.get('seq')} [{r.get('kind')}."
+                   f"{r.get('name')}] {bits}")
+    return out
+
+
+def scan(directory: Path, timeout: float, expect: int | None,
+         telemetry_dir: Path | None = None) -> int:
     # filter the glob through the exact name pattern: a leftover temp/copy
     # like heartbeat-p0.json.bak or heartbeat-pX.json must be skipped, not
     # crash the babysitter
@@ -97,11 +126,20 @@ def scan(directory: Path, timeout: float, expect: int | None) -> int:
         stalled = Heartbeat.is_stalled(path, timeout, now=now)
         done = False
         sick = None
+        run_id = None
         try:
             info = Heartbeat.read(path)
             done = bool(info.get("done"))
+            run_id = info.get("run_id")
             age = now - info["time"]
             detail = f"step {info.get('step', '?')} age {age:.0f}s"
+            # run_id + telemetry_seq correlate this host with its event
+            # stream: "run X stalled at telemetry seq N" is a greppable
+            # coordinate, not a guess
+            if run_id:
+                detail += f" run {run_id}"
+            if info.get("telemetry_seq") is not None:
+                detail += f" tel_seq {info['telemetry_seq']}"
             # loader_stall_s rides every beat (DevicePrefetcher metering):
             # an input-bound host reads as "stall 2.3" here instead of
             # masquerading as a slow chip
@@ -117,6 +155,12 @@ def scan(directory: Path, timeout: float, expect: int | None) -> int:
         status = "done" if done else ("STALLED" if stalled else "ok")
         flag = f"  << UNHEALTHY: {sick}" if sick and not done else ""
         print(f"process {proc}: {status} ({detail}){flag}")
+        if stalled and not done and telemetry_dir is not None:
+            tail = _telemetry_tail(telemetry_dir, proc, run_id)
+            if tail:
+                print(f"  last telemetry of process {proc}:")
+                for line in tail:
+                    print(line)
         bad += stalled and not done
 
     if expect is not None:
@@ -154,6 +198,12 @@ def main(argv=None) -> int:
                         help="managed checkpoint run dir; restarts only "
                              "fire when it holds a manifest-valid "
                              "checkpoint (latest_valid fallback semantics)")
+    parser.add_argument("--telemetry-dir", type=Path, default=None,
+                        help="graftscope events dir (the trainer's "
+                             "--telemetry_dir): a STALLED host's last "
+                             "events are printed under its scan line, so "
+                             "the report says WHAT it was doing, not just "
+                             "that it stopped")
     args = parser.parse_args(argv)
 
     def try_restart(restarts: int) -> int | None:
@@ -194,7 +244,8 @@ def main(argv=None) -> int:
     restarts = 0
     try:
         while True:
-            code = scan(args.heartbeat_dir, args.timeout, args.expect)
+            code = scan(args.heartbeat_dir, args.timeout, args.expect,
+                        telemetry_dir=args.telemetry_dir)
             if args.restart_cmd and code == int(ExitCode.MONITOR_STALLED):
                 stop = try_restart(restarts)
                 if stop is not None:

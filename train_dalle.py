@@ -36,6 +36,7 @@ from dalle_pytorch_tpu import DALLE, DALLEConfig, DiscreteVAE, VAEConfig
 from dalle_pytorch_tpu.cli import host_fetch, select_tokenizer, enable_compilation_cache
 from dalle_pytorch_tpu.data.dataset import DataLoader, TextImageDataset
 from dalle_pytorch_tpu.models.dalle import generate_codes
+from dalle_pytorch_tpu.obs import telemetry as obs
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
 from dalle_pytorch_tpu.training import (make_dalle_train_step, make_optimizer,
                                         set_learning_rate)
@@ -97,6 +98,12 @@ def parse_args(argv=None):
     parser.add_argument('--heartbeat_dir', type=str, default=None,
                         help='write per-process heartbeat-p{i}.json progress '
                              'files here for external stall/death monitors')
+    parser.add_argument('--telemetry_dir', type=str, default=None,
+                        help='graftscope run telemetry: append a schema-'
+                             'versioned events.jsonl (per-step records, '
+                             'ckpt/health/fault/serve events, spans) here '
+                             'for tools/obs_report.py; GRAFT_TELEMETRY=0 '
+                             'hard-disables even when set')
     parser.add_argument('--stall_timeout', type=float, default=0,
                         help='warn on stderr when no step completes for this '
                              'many seconds (0 disables the in-process '
@@ -786,6 +793,18 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                     learning_rate=LEARNING_RATE),
     )
 
+    # graftscope run telemetry (obs/): one events.jsonl per run — every
+    # layer below (ckpt manager, guardrails, faults, loader, serve) emits
+    # into the installed singleton; disabled (a None get()) when no dir
+    if args.telemetry_dir:
+        obs.init(args.telemetry_dir, run_id=logger.run_name,
+                 host=jax.process_index())
+        obs.emit('run', 'run_start', step=start_step, epoch=start_epoch,
+                 config_fingerprint=config_fingerprint(dalle_cfg.to_dict()),
+                 resumed_from=(str(args.dalle_path)
+                               if exists(args.dalle_path) else None),
+                 trainer='train_dalle')
+
     @jax.jit
     def decode_images(vae_params, codes):
         if is_custom_vae:
@@ -904,7 +923,8 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     # files for external monitors, in-process hung-step watchdog
     stopper = GracefulShutdown()
     heartbeat = (Heartbeat(args.heartbeat_dir,
-                           stall_timeout=args.stall_timeout or None)
+                           stall_timeout=args.stall_timeout or None,
+                           run_id=logger.run_name)
                  if args.heartbeat_dir else None)
     # anomaly policy over the per-step health vectors + hung-step watchdog
     monitor_h = (guardrails.HealthMonitor(
@@ -953,6 +973,19 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                         # NaN must not poison the plateau epoch mean either
                         epoch_losses.append(avg_loss)
                     logger.step(epoch, it, avg_loss, lr, extra=perf)
+                    tel = obs.get()
+                    if tel is not None:
+                        # the per-step record: timing/MFU/stall (StepTimer)
+                        # + the health vector, emitted BEFORE the anomaly
+                        # policy observes it so a rollback's health events
+                        # causally follow their step in the stream
+                        fields = dict(step=sid, epoch=epoch, it=it,
+                                      loss=avg_loss, lr=lr, **perf)
+                        if hv is not None:
+                            fields.update(
+                                grad_norm=float(hv['grad_norm']),
+                                applied=float(hv['applied']))
+                        tel.event('step', 'train', **fields)
                     if monitor_h is not None:
                         # every process sees the same avg_loss (collective)
                         # and the same SPMD health scalars, so the verdict —
@@ -1149,6 +1182,14 @@ def _main(argv, lr_scale=1.0, skip_past=None):
             watchdog.close()
         if heartbeat is not None:
             heartbeat.close(done=completed)
+        # run_end folds the StepTimer reservoir percentiles (perf_summary)
+        # so obs_report can show p50/p99 step time without replaying every
+        # step record; shutdown() also makes rollback relaunches (which
+        # re-enter _main in-process) re-init a fresh stream
+        obs.emit('run', 'run_end', step=global_step,
+                 completed=completed, interrupted=interrupted,
+                 **timer.percentiles())
+        obs.shutdown()
 
     if not interrupted:
         final_path = save_model('./dalle-final.pt', EPOCHS)

@@ -29,6 +29,7 @@ import numpy as np
 from dalle_pytorch_tpu import DiscreteVAE, VAEConfig
 from dalle_pytorch_tpu.cli import host_fetch, enable_compilation_cache
 from dalle_pytorch_tpu.data.dataset import DataLoader, ImageFolderDataset
+from dalle_pytorch_tpu.obs import telemetry as obs
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
 from dalle_pytorch_tpu.training import make_optimizer, make_vae_train_step, set_learning_rate
 from dalle_pytorch_tpu.utils import faults, guardrails
@@ -66,6 +67,12 @@ def parse_args(argv=None):
     parser.add_argument('--heartbeat_dir', type=str, default=None,
                         help='write per-process heartbeat-p{i}.json progress '
                              'files here for external stall/death monitors')
+    parser.add_argument('--telemetry_dir', type=str, default=None,
+                        help='graftscope run telemetry: append a schema-'
+                             'versioned events.jsonl (step records, ckpt/'
+                             'health/fault events, spans) here for '
+                             'tools/obs_report.py; GRAFT_TELEMETRY=0 '
+                             'hard-disables even when set')
     parser.add_argument('--stall_timeout', type=float, default=0,
                         help='warn on stderr when no step completes for this '
                              'many seconds (0 disables the in-process '
@@ -390,6 +397,20 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                     learning_rate=LEARNING_RATE),
     )
 
+    # graftscope run telemetry: one events.jsonl per run — the layers
+    # below (ckpt manager, guardrails, faults, loader) emit into the
+    # installed singleton
+    if args.telemetry_dir:
+        obs.init(args.telemetry_dir, run_id=logger.run_name,
+                 host=jax.process_index())
+        obs.emit('run', 'run_start',
+                 step=(int(resume_ckpt.get('global_step', 0))
+                       if resume_ckpt is not None else 0),
+                 epoch=start_epoch,
+                 config_fingerprint=config_fingerprint(cfg.to_dict()),
+                 resumed_from=args.resume_path or None,
+                 trainer='train_vae')
+
     # jitted eval helpers for the periodic "hard reconstruction" probe
     # (ref train_vae.py:187-209): codebook indices -> decode.
     @jax.jit
@@ -463,10 +484,16 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     interrupted = False
     completed = False
     stop_poll = False  # collective stop flag from the last 10-step poll
+    # step timing + the bounded percentile reservoir (flops left None —
+    # images/sec is the VAE's throughput surface, MFU is the DALLE one)
+    from dalle_pytorch_tpu.utils.profiling import StepTimer
+
+    timer = StepTimer()
     # preemption-safe shutdown + stall detection (SURVEY.md §5.3)
     stopper = GracefulShutdown()
     heartbeat = (Heartbeat(args.heartbeat_dir,
-                           stall_timeout=args.stall_timeout or None)
+                           stall_timeout=args.stall_timeout or None,
+                           run_id=logger.run_name)
                  if args.heartbeat_dir else None)
     # training-health guardrails: anomaly policy + hung-step watchdog
     monitor_h = (guardrails.HealthMonitor(
@@ -584,6 +611,10 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                         lr = sched.step()
                         opt_state = set_learning_rate(opt_state, lr)
 
+                    # per-step timing/stall EMAs + the percentile reservoir
+                    # (host-side arithmetic only — no device sync here)
+                    perf = timer.tick(BATCH_SIZE * jax.process_count(),
+                                      stall_s=batches.last_wait_s)
                     if it % 10 == 0:
                         # the preemption check rides the existing 10-step loss
                         # collective (multi-host stop latency <= 10 fast VAE
@@ -592,10 +623,18 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                             distr_backend, loss)
                         dt, t_step = time.perf_counter() - t_step, time.perf_counter()
                         logger.step(epoch, it, avg_loss, lr,
-                                    extra={'temperature': temp,
-                                           'sec_per_10steps': dt,
-                                           'loader_stall_s':
-                                               batches.last_wait_s})
+                                    extra=dict({'temperature': temp,
+                                                'sec_per_10steps': dt},
+                                               **perf))
+                        tel = obs.get()
+                        if tel is not None:
+                            # step records at the loss-sync cadence (the VAE
+                            # loop only materializes the loss every 10
+                            # steps; a per-step host sync would stall the
+                            # device just to log)
+                            tel.event('step', 'train', step=global_step + 1,
+                                      epoch=epoch, it=it, loss=avg_loss,
+                                      lr=lr, temperature=temp, **perf)
                     global_step += 1
                     if args.ckpt_every > 0 and it % args.ckpt_every == 0:
                         # observe THIS step's health before it reaches a
@@ -644,6 +683,11 @@ def _main(argv, lr_scale=1.0, skip_past=None):
             watchdog.close()
         if heartbeat is not None:
             heartbeat.close(done=completed)
+        # run_end carries the StepTimer reservoir percentiles; shutdown
+        # lets in-process relaunches (rollback, tests) start a fresh stream
+        obs.emit('run', 'run_end', step=global_step, completed=completed,
+                 interrupted=interrupted, **timer.percentiles())
+        obs.shutdown()
 
     if not interrupted:
         final_path = save_vae_model('vae-final.pt', EPOCHS)
