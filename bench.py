@@ -34,6 +34,39 @@ FIRST_STEPS = 15  # until a success lands, run fewer scan steps: minutes to JSON
 ATTEMPT_TIMEOUT_DEFAULT = 300.0  # shared by the retry loop, stages, and meta
 
 
+def record_history(record):
+    """Self-record one measurement: a ``bench`` event into the graftscope
+    stream (always — CPU dev runs included, marked by their device kind)
+    and, for REAL-CHIP runs only, the same line appended to
+    all-logs-tpu/bench-history.jsonl.  The event payload IS the history
+    line, so the committed history is derivable from telemetry alone
+    (``tools/obs_report.py --bench-jsonl``); arm the stream with
+    BENCH_TELEMETRY_DIR (or run under a trainer-installed telemetry).
+    Every successful real-chip measurement leaves a committable trace next
+    to the loss artifacts, so numbers taken between sessions (e.g. the
+    driver's end-of-round run) aren't lost when the tunnel dies again."""
+    from dalle_pytorch_tpu.obs import telemetry
+
+    try:
+        line = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "device": jax.devices()[0].device_kind,
+                **record}
+        telemetry.emit("bench", str(record.get("metric", "bench")), **line)
+        if jax.devices()[0].platform == "cpu":
+            return  # CPU runs (tests, dev smoke) are not chip evidence
+        # graftlint: disable=ENV001 (path-valued var: empty/unset mean default)
+        history = os.environ.get("BENCH_HISTORY") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "all-logs-tpu", "bench-history.jsonl")
+        with open(history, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    # graftlint: disable=EXC001 (informational history write: must never cost the round its recorded metric)
+    except Exception as e:  # noqa: BLE001 — the tunnel can die between
+        # the measurement and this write (XlaRuntimeError, not OSError);
+        # history is informational and must never cost the round's metric
+        print(f"bench history not recorded: {e}", file=sys.stderr)
+
+
 def _attempt_timeout() -> float:
     return float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S",
                                 ATTEMPT_TIMEOUT_DEFAULT))
@@ -645,8 +678,17 @@ def main():
     # between bench and perf_ab processes) no longer re-pays the scan
     # compile — the cache is keyed by HLO, shared across processes
     from dalle_pytorch_tpu.cli import enable_compilation_cache
+    from dalle_pytorch_tpu.obs import telemetry as obs
 
     enable_compilation_cache()
+    # graftscope: every bench stage emits a `bench` event (record_history),
+    # so bench-history.jsonl is derivable from the run's telemetry stream
+    # (obs_report --bench-jsonl).  BENCH_TELEMETRY_DIR arms the stream for
+    # standalone bench runs; babysitter stages ride BABYSIT_TEL_DIR.
+    # graftlint: disable=ENV001 (path-valued var: empty/unset mean disabled)
+    if os.environ.get("BENCH_TELEMETRY_DIR"):
+        obs.init(os.environ["BENCH_TELEMETRY_DIR"],
+                 run_id=time.strftime("bench-%Y%m%d-%H%M%S"))
     images_per_sec, dt, cfg, batch, steps, successes = _run_with_retry()
     # MFU context on stderr; the driver consumes only the stdout JSON line.
     # FLOPs are dense-equivalent (sparse layers counted as full attention),
@@ -678,31 +720,8 @@ def main():
     }
     print(json.dumps(payload), flush=True)
 
-    # self-record: every successful REAL-CHIP measurement leaves a
-    # committable trace next to the loss artifacts, so numbers taken
-    # between sessions (e.g. the driver's end-of-round run) aren't lost
-    # when the tunnel dies again.  CPU runs (tests, dev smoke) are not
-    # chip evidence — skipped.
-    def record_history(record):
-        try:
-            if jax.devices()[0].platform == "cpu":
-                return
-            # graftlint: disable=ENV001 (path-valued var: empty/unset mean default)
-            history = os.environ.get("BENCH_HISTORY") or os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "all-logs-tpu", "bench-history.jsonl")
-            with open(history, "a") as f:
-                f.write(json.dumps({
-                    "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                    "device": jax.devices()[0].device_kind,
-                    **record,
-                }) + "\n")
-        # graftlint: disable=EXC001 (informational history write: must never cost the round its recorded metric)
-        except Exception as e:  # noqa: BLE001 — the tunnel can die between
-            # the measurement and this write (XlaRuntimeError, not OSError);
-            # history is informational and must never cost the round's metric
-            print(f"bench history not recorded: {e}", file=sys.stderr)
-
+    # self-record (module-level record_history): bench events into the
+    # graftscope stream + the committable real-chip history line
     record_history({"tflops": round(flops / 1e12, 2),
                     "mfu": round(flops / device_peak_flops(), 4),
                     **payload})
@@ -845,6 +864,7 @@ def main():
                     "unit": "image_tokens/sec",
                     "meta": {"slots": serve_slots, "open_loop": True,
                              "oversubscribe": 1.25}})
+    obs.shutdown()  # flush/close the bench-armed stream (no-op when off)
 
 
 if __name__ == "__main__":

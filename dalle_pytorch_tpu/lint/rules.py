@@ -479,6 +479,58 @@ def _target_names(node: ast.AST) -> List[str]:
     return []
 
 
+def _helper_donation_signatures(tree) -> Dict[str, Tuple[int, ...]]:
+    """Per-function donated-PARAMETER positions: the cross-function half
+    of DON002.  A helper that forwards its own parameter to a donated
+    position of a tracked donating call (a donating jit/factory
+    assignment visible anywhere in the file, or another already-resolved
+    helper — fixed point, so helper-of-helper chains resolve) effectively
+    donates that parameter: the CALLER's variable is dead after the
+    helper returns, exactly as if it had called the jit directly.  Name
+    resolution is file-global and syntactic (no scope analysis) — the
+    over-approximation a pragma can override, same contract as the rest
+    of the rule."""
+    # every single-name donating assignment anywhere in the file (module
+    # scope, function bodies, nested defs): the closure-captured
+    # `_codes_step = make_*_train_step(...)` idiom must resolve inside
+    # the sibling nested def that forwards to it
+    assigned: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            pos = _donated_positions(node.value)
+            if pos:
+                assigned[node.targets[0].id] = pos
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    signatures: Dict[str, Tuple[int, ...]] = {}
+    changed = True
+    while changed:
+        changed = False
+        for fn in fns:
+            param_idx = {a.arg: i for i, a in enumerate(fn.args.args)}
+            donated: set = set(signatures.get(fn.name, ()))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Name):
+                    continue
+                callee = node.func.id
+                positions = assigned.get(callee) or signatures.get(callee)
+                if not positions:
+                    continue
+                for pos in positions:
+                    if pos < len(node.args) \
+                            and isinstance(node.args[pos], ast.Name) \
+                            and node.args[pos].id in param_idx:
+                        donated.add(param_idx[node.args[pos].id])
+            if donated and tuple(sorted(donated)) \
+                    != signatures.get(fn.name):
+                signatures[fn.name] = tuple(sorted(donated))
+                changed = True
+    return signatures
+
+
 def rule_don002(ctx: FileCtx) -> Iterator[RuleHit]:
     """A variable passed at a donated position is DEAD after the call —
     jax invalidates the buffer — yet a read after the call is only caught
@@ -488,11 +540,16 @@ def rule_don002(ctx: FileCtx) -> Iterator[RuleHit]:
     (the ``params, opt_state, ... = step(params, opt_state, ...)`` idiom
     is the clean shape).  Tracks single-name assignments from
     ``jax.jit(..., donate_argnums=...)`` and ``make_*_train_step(...)``
-    calls; syntactic over-approximation — a read on a disjoint branch
-    needs a pragma with the reason."""
+    calls, AND — the cross-function escape — helpers that forward their
+    own parameters to such a call (:func:`_helper_donation_signatures`):
+    a caller's variable handed to ``run_step(params, ...)`` is just as
+    dead as one handed to the jit directly, and reading it afterwards is
+    the same use-after-donation.  Syntactic over-approximation — a read
+    on a disjoint branch needs a pragma with the reason."""
     msg = ("{!r} is donated by this call (position {}) and its buffer is "
            "deleted, but it is read again at line {} in the same scope; "
            "rebind it from the call's outputs or drop the later read")
+    helper_sigs = _helper_donation_signatures(ctx.tree)
     scopes = [ctx.tree] + [
         n for n in ast.walk(ctx.tree)
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
@@ -501,8 +558,10 @@ def rule_don002(ctx: FileCtx) -> Iterator[RuleHit]:
         wrapped = ast.Module(body=body, type_ignores=[])
         # per-scope tracking: a name is donating only while its latest
         # single-name assignment in THIS scope is a donating jit/factory
-        # call (a donate=False or unrelated reassignment drops it)
-        donating: Dict[str, Tuple[int, ...]] = {}
+        # call (a donate=False or unrelated reassignment drops it).
+        # Helpers with donation signatures seed the map — a nested `def
+        # run_step(...)` binding in this scope, or a module-level helper.
+        donating: Dict[str, Tuple[int, ...]] = dict(helper_sigs)
         for node in _walk_skip_defs(wrapped):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name):

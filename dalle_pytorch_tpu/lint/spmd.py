@@ -217,6 +217,76 @@ def check_collective_order(closed_jaxpr, label: str = "step") -> List[Collective
     return sites
 
 
+# --- S1 extension: scan collective schedules ------------------------------
+#
+# Per-body uniformity (above) proves every shard issues the same sequence
+# *per scan iteration*; a pipelined step additionally needs the TOTAL
+# schedule — iteration count x per-iteration sequence — to be a static
+# fact, because the microbatch scan is where the stage-to-stage ppermutes
+# live and a count mismatch between stages is a deadlock the per-body view
+# cannot see.  scan's trip count is static by construction, so the
+# schedule is decidable: extract it, and let the caller pin the
+# per-iteration sequence invariant across schedule-shaping knobs
+# (tools/spmd_check.py compares num_microbatches=2 vs 4 — the sequence
+# must be identical, only the length may change).
+
+
+@dataclasses.dataclass(frozen=True)
+class ScanSchedule:
+    """The collective schedule of one collective-bearing scan: ``length``
+    iterations, each issuing ``per_iteration`` in order (branch-matched
+    conds already flattened; a branch-DIVERGENT cond inside the body is an
+    S1 violation raised during extraction, not a schedule)."""
+
+    context: Tuple[str, ...]             # enclosing HOP chain of the scan
+    length: int                          # static trip count
+    per_iteration: Tuple[Tuple, ...]     # CollectiveSite.signature sequence
+
+    @property
+    def total(self) -> int:
+        return self.length * len(self.per_iteration)
+
+    def format(self) -> str:
+        prims = ",".join(sig[0] for sig in self.per_iteration)
+        ctx = ">".join(self.context) or "top"
+        return (f"{self.length} iterations x [{prims}] = {self.total} "
+                f"collectives @ {ctx}")
+
+
+def scan_collective_schedule(closed_jaxpr,
+                             label: str = "step") -> List[ScanSchedule]:
+    """Every collective-bearing ``scan`` in the program, outermost first,
+    as a static schedule.  Raises :class:`SPMDViolation` if a scan body
+    hides a collective under data-dependent control flow (the conditions
+    under which no static schedule exists)."""
+    out: List[ScanSchedule] = []
+
+    def walk(jaxpr, context: Tuple[str, ...]) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "scan":
+                body = eqn.params["jaxpr"].jaxpr
+                sites: List[CollectiveSite] = []
+                violations: List[str] = []
+                _walk_collectives(body, context + ("scan",), sites,
+                                  violations)
+                if violations:
+                    raise SPMDViolation(
+                        f"S1 scan schedule [{label}]: "
+                        + " | ".join(violations))
+                if sites:
+                    out.append(ScanSchedule(
+                        context=context, length=int(eqn.params["length"]),
+                        per_iteration=tuple(s.signature for s in sites)))
+                # the body was fully analyzed above — no double recursion
+            else:
+                for sub in _sub_jaxprs(eqn.params):
+                    walk(sub, context + (name,))
+
+    walk(getattr(closed_jaxpr, "jaxpr", closed_jaxpr), ())
+    return out
+
+
 # --- S2: donation audit ---------------------------------------------------
 
 

@@ -181,6 +181,62 @@ def oversized_step_compiled(mib: int = 64):
         return jax.jit(step).lower(a, b).compile()
 
 
+# --- S1 (scan schedule): microbatch-scan collective schedules -------------
+
+
+def make_pipelined_collective_scan(mesh, axis: str = "dp",
+                                   length: int = 4):
+    """The clean microbatch-scan shape: every iteration issues the same
+    one-hop ``ppermute`` (the GPipe stage handoff), so the schedule is a
+    static ``length x [ppermute]`` fact.  Must PASS
+    ``scan_collective_schedule`` and report exactly that schedule."""
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(x):
+        def body(carry, _):
+            return jax.lax.ppermute(carry, axis, perm), ()
+
+        out, _ = jax.lax.scan(body, x, None, length=length)
+        return out
+
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(P(axis),),
+                             out_specs=P(axis), check_vma=False))
+
+
+def make_unbalanced_microbatch_scan(mesh, axis: str = "dp",
+                                    length: int = 4):
+    """The anti-pattern the scan-schedule analysis exists to refuse: an
+    epilogue collective folded into the LAST scan iteration via a cond
+    whose other branch issues nothing — the per-iteration collective
+    sequence is no longer a static fact (it depends on the traced
+    iteration index), so no ``iteration-count x per-iteration`` schedule
+    exists and shards whose predicates disagree deadlock.  Must FAIL
+    ``scan_collective_schedule``."""
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(x):
+        def body(carry, t):
+            def epilogue(v):
+                return jax.lax.psum(
+                    jax.lax.ppermute(v, axis, perm), axis)
+
+            carry = jax.lax.cond(t == length - 1, epilogue,
+                                 lambda v: v * n, carry)
+            return carry, ()
+
+        out, _ = jax.lax.scan(body, x, jnp.arange(length))
+        return out
+
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(P(axis),),
+                             out_specs=P(axis), check_vma=False))
+
+
 # --- S3 (serve): a shape-changing decode tick -----------------------------
 
 

@@ -155,13 +155,24 @@ def build_report(events: List[dict]) -> dict:
             "attainment": (sum(bool(r["slo_ok"]) for r in judged)
                            / len(judged) if judged else None),
         }
+    # tick records may be SAMPLED aggregates (GenerationServer
+    # tick_sample > 1): each carries `ticks` = how many decode ticks it
+    # covers (absent = the legacy 1:1 record) and `active_sum` = the
+    # occupied-slot-ticks of the window — sum those, never count records
     ticks = [r for r in serve if r.get("name") == "tick"]
+    covered = sum(int(r.get("ticks", 1)) for r in ticks)
+    slot_ticks = sum(
+        int(r["active_sum"]) if r.get("active_sum") is not None
+        else int(r.get("active", 0)) * int(r.get("ticks", 1))
+        for r in ticks)
     serve_report = {
         "submitted": sum(r.get("name") == "submit" for r in serve),
         "completed": len(retires),
         "failed": sum(r.get("name") == "fail" for r in serve),
         "preemptions": sum(r.get("name") == "preempt" for r in serve),
-        "ticks": len(ticks),
+        "ticks": covered,
+        "tick_records": len(ticks),
+        "occupied_slot_ticks": slot_ticks,
         "decoded_tokens": sum(int(r.get("tokens", 0)) for r in retires),
         "by_class": per_class,
     }

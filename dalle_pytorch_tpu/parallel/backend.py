@@ -131,12 +131,15 @@ class SingleBackend(DistributedBackend):
     def local_barrier(self):
         pass
 
-    def distribute(self, mesh=None, **kwargs) -> Partitioner:
-        # a single process can still drive several local chips: honor the
-        # mesh-shape flags here too (dp absorbs the rest)
+    def distribute(self, mesh=None, plan=None, **kwargs) -> Partitioner:
+        # a single process can still drive several local chips: a declarative
+        # ParallelPlan wins (it IS the mesh-shape contract), else honor the
+        # legacy mesh-shape flags (dp absorbs the rest)
+        if mesh is None and plan is not None:
+            return Partitioner(plan=plan, **kwargs)
         mesh = mesh or self._mesh or make_mesh(
             fsdp=self.mesh_fsdp, tp=self.mesh_tp, dcn_dp=self.mesh_dcn_dp)
-        return Partitioner(mesh=mesh, **kwargs)
+        return Partitioner(mesh=mesh, plan=plan, **kwargs)
 
     def average_all(self, value):
         return value
@@ -259,10 +262,12 @@ class GSPMDBackend(DistributedBackend):
 
         multihost_utils.sync_global_devices("dalle_pytorch_tpu_barrier")
 
-    def distribute(self, mesh=None, **kwargs) -> Partitioner:
+    def distribute(self, mesh=None, plan=None, **kwargs) -> Partitioner:
+        if mesh is None and plan is not None:
+            return Partitioner(plan=plan, **kwargs)
         mesh = mesh or self._mesh or make_mesh(
             fsdp=self.mesh_fsdp, tp=self.mesh_tp, dcn_dp=self.mesh_dcn_dp)
-        return Partitioner(mesh=mesh, **kwargs)
+        return Partitioner(mesh=mesh, plan=plan, **kwargs)
 
     def average_all(self, value):
         if jax.process_count() == 1:
@@ -288,6 +293,17 @@ def wrap_arg_parser(parser):
     )
     # mesh shape is backend-independent (a single process can drive several
     # local chips); dp absorbs the devices the other axes don't claim
+    parser.add_argument("--plan", type=str, default=None,
+                        help="declarative parallelism plan (parallel/"
+                             "plan.py): a registry name (dp, fsdp, tp, "
+                             "sp-ring, sp-ulysses, pp) or an axis spec like "
+                             "'dp2.tp4', 'fsdp4', 'sp-ring2', 'pp2'.  Wins "
+                             "over the individual --mesh_*/--pipeline_"
+                             "stages flags; recorded (with the topology) in "
+                             "every checkpoint manifest, so a preempted run "
+                             "relaunched with a DIFFERENT --plan reshards "
+                             "its restore onto the new mesh (elastic "
+                             "resume)")
     parser.add_argument("--mesh_fsdp", type=int, default=1,
                         help="fsdp (ZeRO-style param/optimizer sharding) "
                              "ways of the device mesh")

@@ -43,34 +43,10 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
                            out_specs=out_specs,
                            **{_SHARD_MAP_CHECK_KW: check_vma})
 
-# Default partition rules for our models' flax param trees.  Matched against
-# the '/'-joined param path; first hit wins; default = replicated.
-# Dense kernels are [d_in, d_out]; embeddings are [vocab, dim].
-DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
-    # fused QKV [dim, 3, heads, dh]: fsdp on features, tp on heads
-    (r".*to_qkv/kernel$", P("fsdp", None, "tp", None)),
-    # column-parallel projections (split output features over tp)
-    (r".*(to_q|to_k|to_v)/kernel$", P("fsdp", "tp")),
-    (r".*ff/dense_in/kernel$", P("fsdp", "tp")),
-    # row-parallel projections (split input features over tp)
-    (r".*to_out/kernel$", P("tp", "fsdp")),
-    (r".*ff/dense_out/kernel$", P("tp", "fsdp")),
-    # token embeddings: vocab over fsdp (the big dim — ZeRO memory win),
-    # features over tp (matches the logits head's tp-sharded vocab).  NOT
-    # P("tp","fsdp"): features-over-fsdp makes the embedding-gradient
-    # scatter reshard its cotangent from batch-sharded to fsdp-on-features
-    # with a tile permutation GSPMD can only do by full rematerialization
-    # ("Involuntary full rematerialization" per step, wasted ICI bandwidth)
-    (r".*(text_emb|image_emb)/embedding$", P("fsdp", "tp")),
-    # per-phase head kernels (PhaseLogits): each phase tp-shards its OWN
-    # vocab dim, so the phase boundary is a param boundary — the sliced
-    # head works under tp with no interior-slice resharding
-    (r".*to_logits_dense/(text_kernel|image_kernel)$", P("fsdp", "tp")),
-    (r".*to_logits_dense/(text_bias|image_bias)$", P("tp")),
-    # conv kernels (VAE): shard output channels over fsdp only
-    (r".*codebook/embedding$", P(None, "fsdp")),
-    (r".*/kernel$", P(None, None)),
-)
+# The partition rule table lives on the declarative plan (plan.py is the
+# single source of the sharding contract); this name survives for the many
+# existing call sites that read it from here.
+from .plan import PARTITION_RULES as DEFAULT_RULES  # noqa: E402,F401
 
 
 def make_mesh(dp: Optional[int] = None, fsdp: int = 1, tp: int = 1,
@@ -158,12 +134,24 @@ def _prune_spec(spec: P, mesh: Mesh, shape) -> P:
 
 
 class Partitioner:
-    """Owns the mesh + param/batch shardings for a training run."""
+    """Owns the mesh + param/batch shardings for a training run.
+
+    Built from a :class:`~dalle_pytorch_tpu.parallel.plan.ParallelPlan`
+    (``plan.partitioner()`` / ``Partitioner(plan=...)``), which is the
+    single source of the mesh axes and rule table — init shardings,
+    checkpoint-restore templates (:meth:`opt_state_templates`), and the
+    step-output pin (``training._pin_update_shardings``) all read THIS
+    object, so the three former hand-kept copies cannot drift."""
 
     def __init__(self, mesh: Optional[Mesh] = None,
-                 rules: Sequence[Tuple[str, P]] = DEFAULT_RULES,
-                 batch_axes=("dp", "fsdp")):
-        self.mesh = mesh if mesh is not None else make_mesh()
+                 rules: Optional[Sequence[Tuple[str, P]]] = None,
+                 batch_axes=("dp", "fsdp"), plan=None):
+        if rules is None:
+            rules = plan.rules if plan is not None else DEFAULT_RULES
+        self.plan = plan
+        if mesh is None:
+            mesh = plan.make_mesh() if plan is not None else make_mesh()
+        self.mesh = mesh
         self.rules = [(re.compile(pat), spec) for pat, spec in rules]
         # drop batch axes the mesh doesn't have (sp/pp meshes carry no fsdp)
         self.batch_axes = tuple(a for a in batch_axes if a in self.mesh.shape)
@@ -221,10 +209,16 @@ class Partitioner:
     def shard_batch(self, batch):
         """Per-process numpy batch -> globally sharded jax.Array.
 
-        Under multi-process JAX each host holds its shard of the global batch
-        (the DataLoader already gives disjoint slices);
-        `make_array_from_process_local_data` assembles the logical global
-        array over ICI/DCN without any host gather.
+        Under multi-process JAX each host holds its shard of the global
+        batch (the DataLoader already gives disjoint slices).  Assembly is
+        explicit per-device placement + ``make_array_from_single_device_
+        arrays`` (SNIPPETS [2]): each addressable device receives exactly
+        its rows of the logical global array, so a resumed run on a
+        DIFFERENT topology (more hosts, a reshaped mesh) feeds the same
+        global batch without any host gather.  When the addressable shards
+        are not one contiguous block of rows (an exotic device order this
+        framework's meshes don't produce), placement falls back to
+        ``make_array_from_process_local_data``.
         """
         batch_size = 1
         for nm in self.batch_axes:
@@ -247,6 +241,34 @@ class Partitioner:
             else:
                 axes = self.batch_axes
             sharding = NamedSharding(self.mesh, P(axes, *([None] * (x.ndim - 1))))
-            return jax.make_array_from_process_local_data(sharding, x)
+            return self._assemble_global(x, sharding, global_rows)
 
         return jax.tree.map(_shard, batch)
+
+    def _assemble_global(self, x, sharding, global_rows: int):
+        """Explicit global-batch assembly: device_put each addressable
+        device's row slice, then bind the buffers into one global array.
+        The host's rows sit at one contiguous block of the global batch
+        (this framework's meshes are row-major with processes owning
+        contiguous device blocks); the block's offset is read off the
+        sharding's own index map rather than assumed."""
+        global_shape = (global_rows,) + x.shape[1:]
+        idx_map = sharding.addressable_devices_indices_map(global_shape)
+
+        def rows(idx):
+            rsl = idx[0] if idx else slice(None)
+            start = 0 if rsl.start is None else int(rsl.start)
+            stop = global_shape[0] if rsl.stop is None else int(rsl.stop)
+            return start, stop
+
+        spans = {dev: rows(idx) for dev, idx in idx_map.items()}
+        row0 = min(s for s, _ in spans.values())
+        row1 = max(e for _, e in spans.values())
+        if row1 - row0 != x.shape[0]:
+            # addressable shards don't tile this host's block contiguously:
+            # let jax work out the local-to-global correspondence
+            return jax.make_array_from_process_local_data(sharding, x)
+        buffers = [jax.device_put(x[s - row0:e - row0], dev)
+                   for dev, (s, e) in spans.items()]
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, buffers)

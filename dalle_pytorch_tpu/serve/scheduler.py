@@ -97,10 +97,22 @@ class GenerationServer:
     def __init__(self, dalle, variables, num_slots: int = 8, *,
                  filter_thres: float = 0.9, top_p: Optional[float] = None,
                  seed: int = 0, time_fn=time.monotonic,
-                 slo_targets: Optional[Dict[str, float]] = None):
+                 slo_targets: Optional[Dict[str, float]] = None,
+                 tick_sample: int = 1):
         self.arena = SlotArena(dalle, variables, num_slots,
                                filter_thres=filter_thres, top_p=top_p)
         self.num_slots = num_slots
+        # telemetry tick sampling: emit one aggregate `serve tick` record
+        # per `tick_sample` decode ticks instead of 1:1 — a week-long serve
+        # process at ~10ms/tick writes ~8.6M tick records a day unsampled.
+        # The aggregate CARRIES the skipped ticks' stats (ticks covered,
+        # summed/min/max active slots, covered clock range), so stream
+        # consumers (obs/report.py) reconstruct totals exactly; partial
+        # windows flush when the server drains idle, so nothing is lost.
+        self.tick_sample = max(1, int(tick_sample))
+        self._tick_agg = {"ticks": 0, "active_sum": 0,
+                          "active_min": None, "active_max": 0,
+                          "clock_first": None}
         # optional end-to-end latency targets (seconds) per SLO class:
         # when set, each retirement records slo_ok and stats()/obs_report
         # aggregate attainment per class
@@ -165,7 +177,12 @@ class GenerationServer:
         self._admit_pending()
         if not tick:
             return 0
-        return self._tick_once()
+        advanced = self._tick_once()
+        if advanced == 0:
+            # drained idle: flush the partial sampling window so the
+            # stream's aggregates cover every tick that actually ran
+            self._flush_tick_agg()
+        return advanced
 
     def run_until_idle(self, max_ticks: Optional[int] = None) -> None:
         """Drive until every queued/running request finishes (or fails)."""
@@ -331,11 +348,36 @@ class GenerationServer:
         self._ticks += 1
         self._occupied_slot_ticks += n
         self._decoded_tokens += n
-        # one record per decode tick (not per slot per tick): occupancy and
-        # clock phase land on the timeline without multiplying the stream
-        # by num_slots
-        telemetry.emit("serve", "tick", clock=self._clock - 1, active=n)
+        # one record per `tick_sample` decode ticks (never per slot per
+        # tick): occupancy and clock phase land on the timeline without
+        # multiplying the stream by num_slots x tick rate
+        agg = self._tick_agg
+        agg["ticks"] += 1
+        agg["active_sum"] += n
+        agg["active_min"] = (n if agg["active_min"] is None
+                             else min(agg["active_min"], n))
+        agg["active_max"] = max(agg["active_max"], n)
+        if agg["clock_first"] is None:
+            agg["clock_first"] = self._clock - 1
+        if agg["ticks"] >= self.tick_sample:
+            self._flush_tick_agg()
         return n
+
+    def _flush_tick_agg(self) -> None:
+        """Emit the aggregate `serve tick` record for the covered window
+        (1 tick at tick_sample=1 — the legacy 1:1 stream — or up to
+        tick_sample skipped ticks' stats in one record)."""
+        agg = self._tick_agg
+        if not agg["ticks"]:
+            return
+        telemetry.emit("serve", "tick", clock=self._clock - 1,
+                       active=agg["active_sum"] / agg["ticks"],
+                       ticks=agg["ticks"], active_sum=agg["active_sum"],
+                       active_min=agg["active_min"],
+                       active_max=agg["active_max"],
+                       clock_first=agg["clock_first"])
+        self._tick_agg = {"ticks": 0, "active_sum": 0, "active_min": None,
+                          "active_max": 0, "clock_first": None}
 
     # --- metrics ------------------------------------------------------------
 
@@ -355,6 +397,7 @@ class GenerationServer:
 
         tokens = (window_tokens if window_tokens is not None
                   else self._decoded_tokens)
+        self._flush_tick_agg()  # a stats() reader sees every tick covered
 
         def attainment(slo):
             target = self.slo_targets.get(slo)
@@ -385,6 +428,7 @@ class GenerationServer:
         bench_serve re-measures without re-paying compiles).  Refuses to
         reset a busy server."""
         assert not self.busy, "reset() on a busy server"
+        self._flush_tick_agg()
         self.completed = []
         self.failed = []
         self.preemption_count = 0

@@ -60,7 +60,12 @@ def _pin_update_shardings(partitioner, params, opt_state):
     jax silently DROPS buffer donation for exactly those leaves (graftspmd
     S2 caught ~2/3 of the donated leaves losing their aliases under the tp
     plan), so those params/opt_state buffers live twice across the
-    update."""
+    update.
+
+    The pin derives from the SAME Partitioner (itself built from the run's
+    declarative ParallelPlan, parallel/plan.py) that sharded the inputs at
+    init and restore — this function holds no sharding table of its own,
+    so the three former hand-kept copies of the contract cannot drift."""
     if partitioner is None:
         return params, opt_state
     params = jax.lax.with_sharding_constraint(

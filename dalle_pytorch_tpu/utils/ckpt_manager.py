@@ -22,8 +22,11 @@ by definition a torn write and :meth:`CheckpointManager.latest_valid`
 skips it.  Manifest fields: ``schema`` (payload schema version), ``step``,
 ``config_fingerprint`` (crc32 of the canonical config JSON — resuming a
 *different* model silently is its own bug class), ``payload`` (the data
-file/dir name), ``files`` (per-file size + crc32, verified on scan), and
-``time``.
+file/dir name), ``files`` (per-file size + crc32, verified on scan),
+``time``, and the elastic-resume provenance pair ``plan`` (the writing
+run's declarative ParallelPlan record, ``parallel/plan.py``) +
+``topology`` (device/process count, platform) — so a resume on different
+hardware can report exactly what it is resharding from.
 
 Fault injection (``GRAFT_FAULTS``, see ``utils/faults.py``) threads through
 ``save`` at the ``ckpt_write`` site so the retry and fallback paths are
@@ -180,7 +183,8 @@ class CheckpointManager:
                  keep_every: int = 0, retries: int = 3,
                  backoff: float = 0.25, sharded: bool = False,
                  fingerprint: Optional[str] = None,
-                 async_save: bool = False):
+                 async_save: bool = False, plan: Optional[dict] = None,
+                 topology: Optional[dict] = None):
         self.run_dir = Path(run_dir)
         self.prefix = prefix
         self.keep_last = int(keep_last)
@@ -189,6 +193,13 @@ class CheckpointManager:
         self.backoff = float(backoff)
         self.sharded = bool(sharded)
         self.fingerprint = fingerprint
+        # elastic-resume provenance: the writing run's ParallelPlan record
+        # (plan.to_manifest()) and topology (plan.current_topology()) ride
+        # every manifest, so a resume on different hardware knows exactly
+        # what it is resharding from — never a verification gate (restores
+        # reshard by construction), purely the operator's provenance trail
+        self.plan = dict(plan) if plan else None
+        self.topology = dict(topology) if topology else None
         # async saves write from a background thread (one in flight; the
         # manifest publish stays the sole commit point).  Orbax sharded
         # saves are COLLECTIVE — every process joins them — and collectives
@@ -333,6 +344,10 @@ class CheckpointManager:
                     "config_fingerprint": self.fingerprint,
                     "payload": data.name, "files": files,
                     "time": time.time()}
+        if self.plan is not None:
+            manifest["plan"] = self.plan
+        if self.topology is not None:
+            manifest["topology"] = self.topology
         # faultpoint: GRAFT_FAULTS="ckpt_async:at_step=N" kills the writer
         # HERE — data fully on disk, manifest never published.  This is the
         # I1 crash window the commit protocol exists for: the directory is

@@ -64,6 +64,14 @@ class ExitCode(enum.IntEnum):
     * ``WEDGED`` (75, EX_TEMPFAIL) — the hung-step watchdog fired: a device
       call or collective never returned.  Transient by definition —
       supervisors relaunch with ``--resume auto``.
+    * ``PREEMPT_EXPIRED`` (74, EX_IOERR) — a preemption notice's grace
+      window ran out before the final checkpoint committed (the
+      ``preempt:at_step`` faultpoint's bounded-grace drill, and the shape
+      of a real scheduler's hard kill): whatever the commit protocol made
+      durable is what resume gets.  Transient — supervisors relaunch with
+      ``--resume auto`` (possibly under a different ``--plan``: the
+      manifest-recorded plan + topology make the checkpoint restorable on
+      whatever hardware the scheduler grants next).
 
     External monitor (``tools/monitor.py``):
 
@@ -79,6 +87,7 @@ class ExitCode(enum.IntEnum):
     MONITOR_NO_HEARTBEATS = 2
     RESTART_BUDGET = 3
     ROLLBACK_BUDGET = 70
+    PREEMPT_EXPIRED = 74
     WEDGED = 75
 
 

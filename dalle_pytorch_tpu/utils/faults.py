@@ -27,6 +27,20 @@ reads, ``sigterm`` in the trainers' step loops).  Actions:
 * ``at_step=N`` — fires once when the caller passes ``step == N``;
   :func:`maybe_kill` turns it into a real ``SIGTERM`` to this process
   (the preemption notice, mid-training).
+* ``grace_ms=N`` — configuration, not a trigger: the grace window (in
+  milliseconds) the ``preempt`` site pairs with its ``at_step``.
+
+Preemption site (both trainers' step loops): ``preempt:at_step=N`` is the
+full preemption drill — :func:`maybe_preempt` delivers a real SIGTERM
+*and* arms a bounded grace window (``preempt:grace_ms=M``, default 30 s —
+the shape of every real scheduler's notice-then-kill contract).  The
+trainer's GracefulShutdown path gets exactly the window to write its
+final checkpoint and exit cleanly; if the window expires first, the
+process hard-exits ``ExitCode.PREEMPT_EXPIRED`` (74) mid-save, leaving
+whatever the manifest commit protocol made durable — the supervisor
+relaunches with ``--resume auto`` (possibly under a different
+``--plan``).  Trainers cancel the window via
+:func:`cancel_preempt_grace` once their final save has committed.
 
 Training-health sites (utils/guardrails.py): ``grad_nan:at_step=N`` and
 ``loss_spike:at_step=N`` drive :func:`guardrails.fault_scale_for`, the
@@ -72,7 +86,7 @@ from typing import Dict, FrozenSet, List, Optional
 
 from ..obs import telemetry
 
-_ACTIONS = ("fail_after", "every", "truncate", "at_step")
+_ACTIONS = ("fail_after", "every", "truncate", "at_step", "grace_ms")
 
 
 class InjectedFault(OSError):
@@ -132,6 +146,15 @@ class FaultRegistry:
         with self._lock:
             return self._hits.get(site, 0)
 
+    def config(self, site: str, action: str) -> Optional[int]:
+        """Value of a configuration action (``grace_ms``) on ``site``, or
+        None when the spec doesn't carry one."""
+        with self._lock:
+            for t in self._triggers.get(site, ()):
+                if t.action == action:
+                    return t.value
+        return None
+
     def fire(self, site: str, step: Optional[int] = None) -> FrozenSet[str]:
         """Register one hit of ``site``; raise or return triggered actions.
 
@@ -142,6 +165,8 @@ class FaultRegistry:
             hits = self._hits[site] = self._hits.get(site, 0) + 1
             actions = set()
             for t in self._triggers.get(site, ()):
+                if t.action == "grace_ms":
+                    continue  # configuration, read via config(), never fires
                 if t.action == "fail_after":
                     if not t.fired and hits == t.value + 1:
                         t.fired = True
@@ -181,8 +206,11 @@ _registry_lock = threading.Lock()
 
 
 def install(spec: str) -> FaultRegistry:
-    """Install an explicit spec (tests); returns the registry."""
+    """Install an explicit spec (tests); returns the registry.  Any grace
+    timer armed by a previous run's preemption drill is cancelled — an
+    in-process rerun must never be hard-killed by its predecessor."""
     global _registry
+    cancel_preempt_grace()
     with _registry_lock:
         _registry = FaultRegistry(spec)
         return _registry
@@ -195,8 +223,10 @@ def install_from_env() -> FaultRegistry:
 
 
 def reset() -> None:
-    """Drop the registry; the next :func:`fire` re-reads the environment."""
+    """Drop the registry (and cancel any armed preemption grace timer);
+    the next :func:`fire` re-reads the environment."""
     global _registry
+    cancel_preempt_grace()
     with _registry_lock:
         _registry = None
 
@@ -224,6 +254,69 @@ def maybe_kill(step: int) -> None:
     checkpoint-and-stop path is rehearsed end to end."""
     if "at_step" in fire("sigterm", step=step):
         signal.raise_signal(signal.SIGTERM)
+
+
+_PREEMPT_DEFAULT_GRACE_S = 30.0
+_preempt_timers: List[threading.Timer] = []
+
+
+def _grace_expired(step: int, grace_s: float) -> None:
+    """The scheduler's hard kill: the grace window closed with the process
+    still running.  ``os._exit`` (not sys.exit) — a real kill runs no
+    finalizers, and the whole point is proving the manifest commit
+    protocol needs none."""
+    import os as _os
+
+    from .failure import ExitCode
+
+    telemetry.note(
+        "fault", "preempt_expired",
+        f"preemption grace window ({grace_s:.1f}s) expired before the "
+        f"final checkpoint committed (step {step}); hard exit "
+        f"{int(ExitCode.PREEMPT_EXPIRED)}", prefix="[faults]", step=step,
+        grace_s=grace_s)
+    _os._exit(int(ExitCode.PREEMPT_EXPIRED))
+
+
+def maybe_preempt(step: int) -> None:
+    """The ``preempt:at_step=N`` site: the full preemption drill.
+
+    Delivers a real SIGTERM (the notice) AND arms a bounded grace window
+    (``preempt:grace_ms=M`` on the same site, default 30 s) on a daemon
+    timer: if the process is still alive when it expires — the final save
+    stalled, a collective wedged — the timer hard-exits
+    ``ExitCode.PREEMPT_EXPIRED`` exactly as the scheduler's follow-up
+    SIGKILL would, mid-write, with no finalizers.  The graceful path
+    (GracefulShutdown → final save → clean exit) must call
+    :func:`cancel_preempt_grace` once its save has committed."""
+    if "at_step" not in fire("preempt", step=step):
+        return
+    grace_ms = get_registry().config("preempt", "grace_ms")
+    grace_s = (_PREEMPT_DEFAULT_GRACE_S if grace_ms is None
+               else grace_ms / 1000.0)
+    telemetry.note(
+        "fault", "preempt",
+        f"preemption notice at step {step}: SIGTERM delivered, "
+        f"{grace_s:.1f}s grace window armed", prefix="[faults]",
+        step=step, grace_s=grace_s)
+    timer = threading.Timer(grace_s, _grace_expired, args=(step, grace_s))
+    timer.daemon = True
+    timer.name = f"preempt-grace-{step}"
+    with _registry_lock:
+        _preempt_timers.append(timer)
+    timer.start()
+    signal.raise_signal(signal.SIGTERM)
+
+
+def cancel_preempt_grace() -> None:
+    """Disarm any armed preemption grace timer: the final checkpoint
+    committed inside the window (or an in-process rerun is starting).
+    Trainers call this on their exit path; without it, a graceful stop
+    that finished in time could still be hard-killed moments later."""
+    with _registry_lock:
+        timers, _preempt_timers[:] = list(_preempt_timers), []
+    for t in timers:
+        t.cancel()
 
 
 def maybe_hang(step: int, cap: float = 3600.0) -> None:

@@ -1,0 +1,394 @@
+"""Elastic resume chaos matrix — cross-topology restore under fire.
+
+The acceptance gates for ISSUE 10's tentpole, layered strongest-first:
+
+* **Preemption drill** (``preempt:at_step`` — SIGTERM + bounded grace
+  window): the graceful path writes its final managed checkpoint inside
+  the window and a SAME-plan resume is bitwise identical to the
+  uninterrupted baseline end to end (weights, opt state, rng, loader).
+* **Checkpoint invariance**: the checkpoint the preempted run leaves
+  behind is bitwise the checkpoint the uninterrupted baseline wrote at
+  the same step — preemption adds nothing and loses nothing.
+* **Cross-topology resume** (dp8 -> dp2·tp4 on the same 8 virtual
+  devices, and dp8 -> dp2·tp2 on a DIFFERENT virtual device count in a
+  subprocess): the preempted-then-migrated run's final params/opt state
+  are bitwise equal (after gather) to a *planned migration* — the same
+  checkpoint restored under the new plan and run uninterrupted.  That is
+  the strongest true cross-topology property: restore + continuation are
+  exact; the *training math itself* differs across plans only by
+  float-reduction order (measured ~1e-7 at this geometry — physics, not
+  a resume bug), which the matrix pins with a tight allclose against the
+  original-plan baseline.
+* **Sharded restore fidelity**: an Orbax checkpoint written under the dp
+  plan restores onto the tp plan's shardings (the two-phase elastic
+  path) with every gathered leaf bitwise intact.
+
+Runs the real CLI mains in-process (the test_crash_resume.py pattern);
+the different-device-count case must re-init jax, so it runs the trainer
+in subprocesses (slow tier; CI's crash-resume job includes it).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+VOCAB_WORDS = ["red", "green", "blue", "yellow", "circle", "square", "bird",
+               "a", "the", "of"]
+HPARAMS = dict(BATCH_SIZE=4, MODEL_DIM=32, TEXT_SEQ_LEN=8, DEPTH=2,
+               HEADS=2, DIM_HEAD=16, ATTN_TYPES=["full", "axial_row"])
+# 12 pairs / batch 4 = 3 steps per epoch; 4 epochs = steps 1..12.  Managed
+# saves (--ckpt_every 4: it==0 of each epoch) land at steps 1, 4, 7, 10;
+# the preemption notice fires at step 7 AFTER that step's cadence save, so
+# the graceful stop's final save is a committed no-op at the same step.
+EPOCHS = 4
+CKPT_EVERY = 4
+PREEMPT_FAULTS = "preempt:at_step=7,preempt:grace_ms=120000"
+
+
+@pytest.fixture(scope="module")
+def tiny_tokenizer_json(tmp_path_factory):
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {"[UNK]": 0}
+    for w in VOCAB_WORDS:
+        vocab[w] = len(vocab)
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    path = tmp_path_factory.mktemp("tok") / "tiny_tokenizer.json"
+    tok.save(str(path))
+    return path
+
+
+@pytest.fixture(scope="module")
+def tiny_dataset(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    folder = tmp_path_factory.mktemp("data")
+    from PIL import Image
+
+    for i in range(12):
+        img = (rng.uniform(size=(24, 24, 3)) * 255).astype(np.uint8)
+        Image.fromarray(img).save(folder / f"sample_{i}.png")
+        words = rng.choice(VOCAB_WORDS, size=3, replace=True)
+        (folder / f"sample_{i}.txt").write_text(" ".join(words) + "\n")
+    return folder
+
+
+@pytest.fixture(scope="module")
+def tiny_vae_ckpt(tmp_path_factory):
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import DiscreteVAE, VAEConfig
+    from dalle_pytorch_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = VAEConfig(image_size=16, num_layers=2, num_tokens=32,
+                    codebook_dim=16, hidden_dim=16, num_resnet_blocks=0)
+    vae = DiscreteVAE(cfg)
+    k = jax.random.PRNGKey(7)
+    params = vae.init({"params": k, "gumbel": k},
+                      jnp.zeros((1, 16, 16, 3)))["params"]
+    path = tmp_path_factory.mktemp("vae") / "vae.pt"
+    save_checkpoint(path, {"hparams": cfg.to_dict(),
+                           "weights": jax.device_get(params)})
+    return path
+
+
+def run_train(workdir, data, vae, tok, extra_args, faults_spec=None,
+              epochs=EPOCHS):
+    env_before = os.environ.get("GRAFT_FAULTS")
+    os.environ["DALLE_TPU_HPARAMS"] = json.dumps(HPARAMS)
+    if faults_spec is None:
+        os.environ.pop("GRAFT_FAULTS", None)
+    else:
+        os.environ["GRAFT_FAULTS"] = faults_spec
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        import train_dalle
+
+        train_dalle.main(["--image_text_folder", str(data),
+                          "--bpe_path", str(tok),
+                          "--truncate_captions",
+                          "--learning_rate", "1e-3",
+                          "--epochs", str(epochs),
+                          "--ckpt_every", str(CKPT_EVERY),
+                          "--keep_checkpoints", "8"]
+                         + (["--vae_path", str(vae)] if vae else [])
+                         + extra_args)
+    finally:
+        os.chdir(cwd)
+        del os.environ["DALLE_TPU_HPARAMS"]
+        if env_before is None:
+            os.environ.pop("GRAFT_FAULTS", None)
+        else:
+            os.environ["GRAFT_FAULTS"] = env_before
+    from dalle_pytorch_tpu.utils import faults as faults_mod
+
+    faults_mod.reset()  # never leak an armed registry/grace timer
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _leaves(tree[k])
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _leaves(v)
+    elif hasattr(tree, "shape"):
+        yield tree
+
+
+def assert_state_bitwise(a, b, keys=("weights", "opt_state")):
+    for key in keys:
+        a_leaves = [np.asarray(v) for v in _leaves(a[key])]
+        b_leaves = [np.asarray(v) for v in _leaves(b[key])]
+        assert len(a_leaves) == len(b_leaves), key
+        for x, y in zip(a_leaves, b_leaves):
+            np.testing.assert_array_equal(x, y)
+    assert list(a["rng"]) == list(b["rng"])
+    assert dict(a["loader"]) == dict(b["loader"])
+    assert int(a["global_step"]) == int(b["global_step"])
+
+
+@pytest.fixture(scope="module")
+def baseline(tiny_dataset, tiny_vae_ckpt, tiny_tokenizer_json,
+             tmp_path_factory):
+    """Uninterrupted dp run: the reference trajectory + its checkpoints."""
+    wd = tmp_path_factory.mktemp("baseline")
+    run_train(wd, tiny_dataset, tiny_vae_ckpt, tiny_tokenizer_json, [])
+    return wd
+
+
+@pytest.fixture(scope="module")
+def preempted(tiny_dataset, tiny_vae_ckpt, tiny_tokenizer_json,
+              tmp_path_factory):
+    """The dp run killed by the preemption drill at step 7 (graceful:
+    the grace window is generous, so the notice path saves and exits
+    cleanly).  Pristine — tests COPY it before resuming."""
+    wd = tmp_path_factory.mktemp("preempted")
+    run_train(wd, tiny_dataset, tiny_vae_ckpt, tiny_tokenizer_json, [],
+              faults_spec=PREEMPT_FAULTS)
+    assert not (wd / "dalle-final.pt").exists()
+    return wd
+
+
+def _copy_run(src: Path, tmp_path_factory, name: str) -> Path:
+    dst = tmp_path_factory.mktemp(name)
+    for item in src.iterdir():
+        if item.is_dir():
+            shutil.copytree(item, dst / item.name)
+        else:
+            shutil.copy2(item, dst / item.name)
+    return dst
+
+
+def test_preempt_drill_leaves_committed_plan_stamped_checkpoint(preempted):
+    from dalle_pytorch_tpu.utils.ckpt_manager import latest_valid
+
+    info = latest_valid(preempted / "checkpoints")
+    assert info is not None and info.step == 7
+    assert info.manifest["plan"]["spec"] == "dp"
+    assert info.manifest["topology"]["device_count"] == 8
+    assert info.manifest["topology"]["process_count"] == 1
+
+
+def test_preempted_checkpoint_bitwise_equals_baseline_checkpoint(
+        baseline, preempted):
+    """Checkpoint invariance: the step-7 checkpoint of the preempted run
+    IS the baseline's step-7 checkpoint, bit for bit — the drill neither
+    corrupted nor perturbed the committed state."""
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+    from dalle_pytorch_tpu.utils.ckpt_manager import verify
+
+    a = verify(baseline / "checkpoints" / "ckpt-00000007")
+    b = verify(preempted / "checkpoints" / "ckpt-00000007")
+    assert a is not None and b is not None
+    assert_state_bitwise(load_checkpoint(a.payload),
+                         load_checkpoint(b.payload))
+
+
+def test_same_plan_resume_after_preempt_bitwise(baseline, preempted,
+                                                tiny_dataset,
+                                                tiny_tokenizer_json,
+                                                tmp_path_factory):
+    """The preemption drill composes with the existing exact-resume
+    guarantee: resume on the SAME plan -> final state bitwise equal the
+    uninterrupted baseline."""
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    wd = _copy_run(preempted, tmp_path_factory, "resume_same")
+    run_train(wd, tiny_dataset, None, tiny_tokenizer_json,
+              ["--resume", "auto"])
+    assert_state_bitwise(load_checkpoint(baseline / "dalle-final.pt"),
+                         load_checkpoint(wd / "dalle-final.pt"))
+
+
+def test_cross_topology_resume_dp_to_dp2tp4(baseline, preempted,
+                                            tiny_dataset,
+                                            tiny_tokenizer_json,
+                                            tmp_path_factory, capsys):
+    """dp8 -> dp2·tp4 on the same 8 virtual devices.  The preempted run
+    resumed under the NEW plan must be bitwise equal to the planned
+    migration (baseline's step-7 checkpoint restored under dp2·tp4, run
+    uninterrupted), and agree with the dp baseline to float-reduction
+    order."""
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    # planned migration: the baseline's checkpoints, resumed under tp4
+    migr = _copy_run(baseline, tmp_path_factory, "migration")
+    (migr / "dalle-final.pt").unlink()
+    # drop post-handoff checkpoints so the migration resumes at step 7
+    for late in ("ckpt-00000010",):
+        shutil.rmtree(migr / "checkpoints" / late, ignore_errors=True)
+    run_train(migr, tiny_dataset, None, tiny_tokenizer_json,
+              ["--resume", "auto", "--plan", "dp2.tp4"])
+
+    # the drill: preempted on dp, relaunched under dp2·tp4
+    wd = _copy_run(preempted, tmp_path_factory, "resume_tp4")
+    run_train(wd, tiny_dataset, None, tiny_tokenizer_json,
+              ["--resume", "auto", "--plan", "dp2.tp4"])
+    out = capsys.readouterr().out
+    assert "auto-resume: step 7" in out
+    assert "resharding onto plan dp2.tp4" in out
+
+    final_chaos = load_checkpoint(wd / "dalle-final.pt")
+    final_migr = load_checkpoint(migr / "dalle-final.pt")
+    assert_state_bitwise(final_chaos, final_migr)
+
+    # vs the dp baseline: identical up to float-reduction order — the
+    # plans reschedule the same math (psum order differs), nothing more
+    final_dp = load_checkpoint(baseline / "dalle-final.pt")
+    for key in ("weights", "opt_state"):
+        for x, y in zip(_leaves(final_dp[key]), _leaves(final_chaos[key])):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=2e-4, atol=2e-6)
+    assert list(final_dp["rng"]) == list(final_chaos["rng"])
+
+
+def test_sharded_checkpoint_restores_across_plans_bitwise(tmp_path):
+    """Orbax two-phase elastic restore fidelity: a sharded checkpoint
+    written under the dp plan restores onto the tp plan's shardings (and
+    back) with every gathered leaf bitwise intact — the resharding is in
+    the READ pattern, never the values."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import DALLE, DALLEConfig
+    from dalle_pytorch_tpu.parallel.plan import PLAN_REGISTRY
+    from dalle_pytorch_tpu.training import make_optimizer
+    from dalle_pytorch_tpu.utils.checkpoint import (load_checkpoint_sharded,
+                                                    load_sharded_small,
+                                                    save_checkpoint_sharded)
+
+    cfg = DALLEConfig(dim=32, depth=2, heads=4, dim_head=8,
+                      num_text_tokens=48, text_seq_len=8,
+                      num_image_tokens=32, image_size=64, image_fmap_size=4)
+    dalle = DALLE(cfg)
+    text = jnp.zeros((2, cfg.text_seq_len), jnp.int32)
+    codes = jnp.zeros((2, cfg.image_seq_len), jnp.int32)
+    params_host = jax.device_get(jax.jit(
+        lambda r: dalle.init(r, text, codes)["params"])(
+            jax.random.PRNGKey(3)))
+    tx = make_optimizer(1e-3)
+
+    dp = PLAN_REGISTRY["dp"].partitioner()
+    params_dp = dp.shard_params(jax.tree.map(jnp.asarray, params_host))
+    opt_dp = dp.init_opt_state(tx, params_dp)
+    path = tmp_path / "ckpt.orbax"
+    save_checkpoint_sharded(path, {
+        "hparams": cfg.to_dict(), "weights": params_dp,
+        "opt_state": jax.tree.leaves(opt_dp), "global_step": 7})
+
+    # phase 1+2 under the TP plan: templates carry the NEW shardings
+    tp = PLAN_REGISTRY["tp"].partitioner()
+    small = load_sharded_small(path)
+    assert int(small["global_step"]) == 7
+    shapes = jax.eval_shape(lambda: params_dp)
+    templates = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, tp.param_shardings(shapes))
+    opt_templates = tp.opt_state_templates(jax.eval_shape(tx.init,
+                                                          templates))
+    target = dict(small)
+    target["weights"] = templates
+    target["opt_state"] = [
+        sds if saved is ... else saved
+        for sds, saved in zip(opt_templates, small["opt_state"])]
+    restored = load_checkpoint_sharded(path, target=target)
+
+    for leaf, tmpl in zip(jax.tree.leaves(restored["weights"]),
+                          jax.tree.leaves(templates)):
+        assert leaf.sharding.is_equivalent_to(tmpl.sharding, leaf.ndim)
+    for a, b in zip(_leaves(params_host),
+                    _leaves(jax.device_get(restored["weights"]))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(jax.device_get(opt_dp)),
+                    [jax.device_get(v) for v in restored["opt_state"]]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _subprocess_resume(workdir, data, tok, plan: str, device_count: int):
+    """Resume a run in a fresh process on a DIFFERENT virtual device
+    count (jax fixes the device count at init, so this cannot happen
+    in-process)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "").replace(
+            "--xla_force_host_platform_device_count=8", "")
+        + f" --xla_force_host_platform_device_count={device_count}")
+    env["DALLE_TPU_HPARAMS"] = json.dumps(HPARAMS)
+    env.pop("GRAFT_FAULTS", None)
+    code = (
+        "import sys; sys.path.insert(0, {repo!r})\n"
+        "import train_dalle\n"
+        "train_dalle.main({args!r})\n"
+    ).format(repo=str(REPO), args=[
+        "--image_text_folder", str(data), "--bpe_path", str(tok),
+        "--truncate_captions", "--learning_rate", "1e-3",
+        "--epochs", str(EPOCHS), "--ckpt_every", str(CKPT_EVERY),
+        "--keep_checkpoints", "8", "--resume", "auto", "--plan", plan])
+    proc = subprocess.run([sys.executable, "-c", code], cwd=workdir,
+                          env=env, capture_output=True, text=True,
+                          timeout=900)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_resume_on_different_device_count_bitwise(baseline, preempted,
+                                                  tiny_dataset,
+                                                  tiny_tokenizer_json,
+                                                  tmp_path_factory):
+    """dp8 (8 virtual devices) -> dp2·tp2 on 4 virtual devices: the
+    preempted run relaunched in a fresh 4-device process is bitwise equal
+    to the planned 4-device migration from the baseline's checkpoint —
+    the device count is just another resharding axis."""
+    from dalle_pytorch_tpu.utils.checkpoint import load_checkpoint
+
+    migr = _copy_run(baseline, tmp_path_factory, "migration4")
+    (migr / "dalle-final.pt").unlink()
+    shutil.rmtree(migr / "checkpoints" / "ckpt-00000010",
+                  ignore_errors=True)
+    out = _subprocess_resume(migr, tiny_dataset, tiny_tokenizer_json,
+                             "dp2.tp2", device_count=4)
+    assert "auto-resume: step 7" in out
+
+    wd = _copy_run(preempted, tmp_path_factory, "resume4")
+    out = _subprocess_resume(wd, tiny_dataset, tiny_tokenizer_json,
+                             "dp2.tp2", device_count=4)
+    assert "auto-resume: step 7" in out
+    assert "resharding onto plan dp2.tp2 (4 devices)" in out
+
+    assert_state_bitwise(load_checkpoint(wd / "dalle-final.pt"),
+                         load_checkpoint(migr / "dalle-final.pt"))

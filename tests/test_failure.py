@@ -30,11 +30,15 @@ def test_exit_code_taxonomy_is_frozen():
     assert int(ExitCode.MONITOR_NO_HEARTBEATS) == 2
     assert int(ExitCode.RESTART_BUDGET) == 3
     assert int(ExitCode.ROLLBACK_BUDGET) == 70  # terminal: never restart
+    # transient: the preemption grace window expired mid-save; resume from
+    # the last committed manifest (possibly under a different --plan)
+    assert int(ExitCode.PREEMPT_EXPIRED) == 74
     assert int(ExitCode.WEDGED) == 75  # transient: restart with --resume
     # the trainer-side codes must never collide with the monitor's own
     assert len({ExitCode.MONITOR_STALLED, ExitCode.MONITOR_NO_HEARTBEATS,
                 ExitCode.RESTART_BUDGET, ExitCode.ROLLBACK_BUDGET,
-                ExitCode.WEDGED, ExitCode.CLEAN}) == 6
+                ExitCode.PREEMPT_EXPIRED, ExitCode.WEDGED,
+                ExitCode.CLEAN}) == 7
 
 
 def test_graceful_shutdown_sets_flag_on_signal():

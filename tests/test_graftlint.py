@@ -502,6 +502,81 @@ def test_don002_nested_def_params_shadow_outer_names():
     assert lint(src, select=("DON002",)) == []
 
 
+def test_don002_cross_function_helper_forward_flagged():
+    """The cross-function escape (carried PR 5 follow-up): a helper that
+    forwards its own parameters to a donating call donates them too — the
+    CALLER's variable is dead after the helper returns, and a later read
+    is the same use-after-donation the same-scope rule catches."""
+    src = """
+    def train(params, opt_state, batches, model, tx):
+        _codes_step = make_toy_train_step(model, tx)
+
+        def run_step(params, opt_state, batch):
+            return _codes_step(params, opt_state, encode(batch))
+
+        new_p, new_o, loss = run_step(params, opt_state, batches[0])
+        save(params)  # stale: donated through the helper
+    """
+    found = lint(src, select=("DON002",))
+    assert rules_of(found) == ["DON002"]
+    assert "'params'" in found[0].message
+
+
+def test_don002_cross_function_chain_resolves_fixed_point():
+    """helper-of-helper: the donation signature propagates through the
+    chain (module-level defs), flagging the caller of the OUTERMOST
+    wrapper."""
+    src = """
+    import jax
+    step = jax.jit(f, donate_argnums=(0, 1))
+
+    def inner(params, opt_state, batch):
+        return step(params, opt_state, batch)
+
+    def outer(params, opt_state, batch):
+        return inner(params, opt_state, batch)
+
+    def train(params, opt_state, batches):
+        new_p, new_o, loss = outer(params, opt_state, batches[0])
+        save(params)
+    """
+    found = lint(src, select=("DON002",))
+    assert rules_of(found) == ["DON002"]
+    assert "'params'" in found[0].message
+
+
+def test_don002_cross_function_clean_shapes():
+    """Negatives: a helper over a donate=False factory donates nothing;
+    a caller that REBINDS through the helper (the trainers' idiom) is the
+    clean shape."""
+    src = """
+    def train(params, opt_state, batches, model, tx):
+        _codes_step = make_toy_train_step(model, tx, donate=False)
+
+        def run_step(params, opt_state, batch):
+            return _codes_step(params, opt_state, batch)
+
+        new_p, new_o, loss = run_step(params, opt_state, batches[0])
+        save(params)
+    """
+    assert lint(src, select=("DON002",)) == []
+
+    src2 = """
+    import jax
+    step = jax.jit(f, donate_argnums=(0, 1))
+
+    def helper(params, opt_state, batch):
+        params, opt_state, loss = step(params, opt_state, batch)
+        return params, opt_state, loss
+
+    def train(params, opt_state, batches):
+        for batch in batches:
+            params, opt_state, loss = helper(params, opt_state, batch)
+        save(params)
+    """
+    assert lint(src2, select=("DON002",)) == []
+
+
 def test_don002_pragma():
     src = """
     import jax

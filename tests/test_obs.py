@@ -415,6 +415,100 @@ def test_serve_request_fault_leaves_causal_trail(tmp_path, tiny_serve):
     assert rep["serve"]["completed"] == 1 and rep["serve"]["failed"] == 1
 
 
+def test_serve_tick_sampling_aggregates_preserve_report(tmp_path,
+                                                        tiny_serve):
+    """Tick-event sampling (tick_sample=N): the stream shrinks ~N-fold
+    but carries the skipped ticks' stats in aggregate records — the
+    report's tick totals and occupied-slot-ticks reconstruct EXACTLY the
+    unsampled stream's, and the partial window flushes when the server
+    drains idle so nothing is lost."""
+    from dalle_pytorch_tpu.obs import telemetry
+    from dalle_pytorch_tpu.obs.report import build_report
+    from dalle_pytorch_tpu.serve import GenerationServer
+
+    def drive(sample):
+        telemetry.init(tmp_path / f"tel-s{sample}",
+                       run_id=f"sample-{sample}")
+        srv = GenerationServer(tiny_serve[0], tiny_serve[1], num_slots=2,
+                               tick_sample=sample)
+        srv.submit(tiny_serve[2][0])
+        for _ in range(3):
+            srv.step()
+        srv.submit(tiny_serve[2][1])  # mid-flight admission
+        srv.run_until_idle(max_ticks=400)
+        stats = srv.stats()
+        telemetry.shutdown()
+        recs = telemetry.read_events(tmp_path / f"tel-s{sample}")
+        return stats, [r for r in recs if r.get("kind") == "serve"
+                       and r.get("name") == "tick"], build_report(recs)
+
+    stats1, ticks1, rep1 = drive(1)
+    stats3, ticks3, rep3 = drive(3)
+    # the servers ran the identical schedule
+    assert stats1["ticks"] == stats3["ticks"] > 0
+    # sampled stream: fewer records, same covered totals
+    assert len(ticks3) < len(ticks1)
+    assert sum(int(r.get("ticks", 1)) for r in ticks3) == stats3["ticks"]
+    assert rep3["serve"]["ticks"] == rep1["serve"]["ticks"] \
+        == stats1["ticks"]
+    occupied = stats1["occupancy"] * stats1["ticks"] * 2  # 2 slots
+    assert rep1["serve"]["occupied_slot_ticks"] \
+        == rep3["serve"]["occupied_slot_ticks"] \
+        == pytest.approx(occupied)
+    # every aggregate self-describes its window
+    for r in ticks3:
+        assert r["ticks"] <= 3
+        assert r["active_min"] <= r["active"] <= r["active_max"]
+        assert r["active_sum"] == pytest.approx(r["active"] * r["ticks"])
+
+
+def test_bench_events_make_history_derivable(tmp_path, capsys):
+    """bench.record_history emits the exact bench-history.jsonl payload
+    as a `bench` event (CPU runs included — marked by device kind), and
+    ``obs_report --bench-jsonl`` extracts the lines back out: the
+    committed perf history is derivable from telemetry alone."""
+    import importlib.util
+    import json as _json
+
+    from dalle_pytorch_tpu.obs import telemetry
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_obs_test", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    telemetry.init(tmp_path / "tel", run_id="bench-test")
+    record = {"metric": "dalle_cub200_train_throughput", "value": 42.5,
+              "unit": "images/sec/chip", "vs_baseline": None,
+              "meta": {"steps": 5, "batch": 16}}
+    bench.record_history(dict(record))
+    bench.record_history({"metric": "dalle_cub200_gen_throughput",
+                          "value": 1000.0, "unit": "image_tokens/sec",
+                          "meta": {"batch": 8}})
+    telemetry.shutdown()
+
+    recs = [r for r in telemetry.read_events(tmp_path / "tel")
+            if r.get("kind") == "bench"]
+    assert [r["name"] for r in recs] == ["dalle_cub200_train_throughput",
+                                         "dalle_cub200_gen_throughput"]
+    assert recs[0]["value"] == 42.5 and recs[0]["meta"]["batch"] == 16
+    assert "ts" in recs[0] and "device" in recs[0]  # the history envelope
+
+    spec2 = importlib.util.spec_from_file_location(
+        "obs_report_for_bench_test", REPO / "tools" / "obs_report.py")
+    obs_report = importlib.util.module_from_spec(spec2)
+    spec2.loader.exec_module(obs_report)
+    assert obs_report.main([str(tmp_path / "tel"), "--bench-jsonl"]) == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 2
+    derived = _json.loads(lines[0])
+    # payload only — envelope stripped — and the record rides intact
+    assert derived["metric"] == record["metric"]
+    assert derived["value"] == record["value"]
+    assert derived["meta"] == record["meta"]
+    assert "seq" not in derived and "run" not in derived
+
+
 # --- read side: fixture stream, report, Perfetto --------------------------
 
 

@@ -104,6 +104,46 @@ def test_s1_recurses_into_scan_bodies():
     assert any("scan" in c for c in sites[0].context)
 
 
+# --- S1 extension: scan collective schedules (the pp microbatch gate) -----
+
+
+def test_scan_schedule_extracts_length_times_sequence():
+    """The clean GPipe-shaped scan: the schedule is a static
+    ``length x [ppermute]`` fact, with the total derivable."""
+    mesh = make_mesh()
+    x = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+    scheds = spmd.scan_collective_schedule(
+        jax.make_jaxpr(fx.make_pipelined_collective_scan(mesh, length=5))(x))
+    assert len(scheds) == 1
+    s = scheds[0]
+    assert s.length == 5
+    assert [sig[0] for sig in s.per_iteration] == ["ppermute"]
+    assert s.total == 5
+    assert "5 iterations x [ppermute]" in s.format()
+
+
+def test_scan_schedule_refuses_unbalanced_microbatch_scan():
+    """The epilogue-folded-into-the-last-iteration anti-pattern: a cond
+    inside the scan body whose branches issue DIFFERENT collective
+    sequences means no static iteration-count x sequence schedule exists
+    — refused, not mis-summarized."""
+    mesh = make_mesh()
+    x = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+    with pytest.raises(spmd.SPMDViolation, match="scan schedule"):
+        spmd.scan_collective_schedule(
+            jax.make_jaxpr(fx.make_unbalanced_microbatch_scan(mesh))(x))
+
+
+def test_pp_scan_schedule_check_passes_and_reports(cli):
+    """The production pp step's microbatch scan obeys the law: trip count
+    = microbatches + stages - 1, per-iteration collective sequence
+    IDENTICAL across microbatch counts (forward and transposed backward
+    scans both)."""
+    detail = cli.pp_scan_schedule_check()
+    assert "(m + pp - 1) x fixed sequence" in detail
+    assert "m=2: 3 iterations" in detail and "m=4: 5 iterations" in detail
+
+
 # --- S2: donation audit ---------------------------------------------------
 
 

@@ -162,12 +162,19 @@ echo "$(date +%T) spmd check PASS"
 # BABYSIT_STEP_DEADLINE > 0 arms the trainer's in-process hung-step
 # watchdog (--step_deadline) so a wedge inside a device call turns into
 # the rc=75 relaunch instead of waiting out the heartbeat stall scan.
+# BABYSIT_RELAUNCH_PLAN (elastic resume): when set, every RELAUNCH (never
+# the first launch) appends "--plan $BABYSIT_RELAUNCH_PLAN" — the shape of
+# a preempted pod coming back on whatever topology the scheduler granted:
+# checkpoint manifests record the written-under plan and the restore
+# reshards onto the new one (rc=74 PREEMPT_EXPIRED, like rc=75, is a
+# transient death that resumes from the last committed manifest).
 if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
   BABYSIT_HB_DIR=${BABYSIT_HB_DIR:-${CHIP_TMP}/train_hb}
   BABYSIT_MAX_RESTARTS=${BABYSIT_MAX_RESTARTS:-3}
   BABYSIT_STALL_TIMEOUT=${BABYSIT_STALL_TIMEOUT:-600}
   BABYSIT_POLL=${BABYSIT_POLL:-60}
   BABYSIT_STEP_DEADLINE=${BABYSIT_STEP_DEADLINE:-0}
+  BABYSIT_RELAUNCH_PLAN=${BABYSIT_RELAUNCH_PLAN:-}
   # graftscope stream: the supervised run appends its events here, and on
   # every death/stall the victim's last events land in train_run.log via
   # obs_report --tail — a babysitter restart carries the previous run's
@@ -176,10 +183,18 @@ if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
   (
     restarts=0
     while :; do
+      # elastic relaunch: restarts (not the first launch) may come back on
+      # a different parallelism plan — the manifest-recorded written-under
+      # plan makes the restore reshard onto it
+      plan_args=""
+      if [ "$restarts" -gt 0 ] && [ -n "$BABYSIT_RELAUNCH_PLAN" ]; then
+        plan_args="--plan ${BABYSIT_RELAUNCH_PLAN}"
+        echo "$(date +%T) train supervisor: relaunching under --plan ${BABYSIT_RELAUNCH_PLAN} (elastic resume)"
+      fi
       echo "$(date +%T) train supervisor: launch (restarts so far: $restarts/${BABYSIT_MAX_RESTARTS})"
       ${BABYSIT_TRAIN_CMD} --resume auto --heartbeat_dir "${BABYSIT_HB_DIR}" \
         --step_deadline "${BABYSIT_STEP_DEADLINE}" \
-        --telemetry_dir "${BABYSIT_TEL_DIR}" \
+        --telemetry_dir "${BABYSIT_TEL_DIR}" ${plan_args} \
         >> "${CHIP_TMP}/train_run.log" 2>&1 &
       train_pid=$!
       while kill -0 "$train_pid" 2>/dev/null; do
@@ -218,6 +233,8 @@ if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
       fi
       if [ "$rc" -eq 75 ]; then  # ExitCode.WEDGED: transient, resume
         echo "$(date +%T) train supervisor: rc=75 hung-step watchdog — relaunching with --resume auto"
+      elif [ "$rc" -eq 74 ]; then  # ExitCode.PREEMPT_EXPIRED: transient
+        echo "$(date +%T) train supervisor: rc=74 preemption grace expired mid-save — relaunching from the last committed manifest"
       else
         echo "$(date +%T) train supervisor: rc=$rc — restarting from the last good checkpoint"
       fi

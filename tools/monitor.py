@@ -198,6 +198,15 @@ def main(argv=None) -> int:
                         help="managed checkpoint run dir; restarts only "
                              "fire when it holds a manifest-valid "
                              "checkpoint (latest_valid fallback semantics)")
+    parser.add_argument("--restart-plan", type=str, default=None,
+                        help="elastic relaunch: append '--plan SPEC' to "
+                             "--restart-cmd so the restarted trainer "
+                             "reshards its resume onto a DIFFERENT "
+                             "parallelism plan / topology (e.g. the "
+                             "smaller pod the scheduler granted after a "
+                             "preemption); checkpoint manifests record "
+                             "the written-under plan, the restore "
+                             "reshards by construction")
     parser.add_argument("--telemetry-dir", type=Path, default=None,
                         help="graftscope events dir (the trainer's "
                              "--telemetry_dir): a STALLED host's last "
@@ -223,6 +232,21 @@ def main(argv=None) -> int:
                       "nothing to restart from", file=sys.stderr)
                 return int(ExitCode.RESTART_BUDGET)
             cmd = cmd.replace("{ckpt}", str(info.payload))
+            written = (info.manifest.get("plan") or {}).get("spec")
+            if written and args.restart_plan \
+                    and written != args.restart_plan:
+                print(f"elastic restart: checkpoint written under plan "
+                      f"{written}; relaunching under --plan "
+                      f"{args.restart_plan} (restore reshards on load)",
+                      file=sys.stderr)
+        if args.restart_plan:
+            # '{plan}' in the command places the spec explicitly (compound
+            # commands, backgrounded trainers); otherwise the flag pair is
+            # appended
+            if "{plan}" in cmd:
+                cmd = cmd.replace("{plan}", args.restart_plan)
+            else:
+                cmd = f"{cmd} --plan {args.restart_plan}"
         print(f"restart {restarts + 1}/{args.max_restarts}: {cmd}",
               file=sys.stderr)
         rc = subprocess.run(cmd, shell=True).returncode
@@ -237,6 +261,11 @@ def main(argv=None) -> int:
         if rc == int(ExitCode.WEDGED):
             print(f"restarted trainer exited {rc} (hung-step watchdog) — "
                   "transient, will relaunch on the next stalled scan",
+                  file=sys.stderr)
+        if rc == int(ExitCode.PREEMPT_EXPIRED):
+            print(f"restarted trainer exited {rc} (preemption grace window "
+                  "expired mid-save) — transient, the last committed "
+                  "manifest resumes it on the next stalled scan",
                   file=sys.stderr)
         return None
 

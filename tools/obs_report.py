@@ -16,6 +16,10 @@ trainers / serve scheduler via ``dalle_pytorch_tpu.obs`` and renders:
 * ``--tail N``       — just the last N records per host (the babysitter
   and monitor use this to carry a dead run's final moments into their own
   logs).
+* ``--bench-jsonl``  — extract the ``bench`` events back into
+  bench-history.jsonl lines (bench.py's ``record_history`` emits the
+  exact history payload as the event), so the committed perf history is
+  derivable from a run's telemetry stream alone.
 
 Stdlib + the jax-free ``obs`` package only: this tool must run on a box
 whose accelerator tunnel is wedged — that is precisely when it is needed.
@@ -52,6 +56,11 @@ def main(argv=None) -> int:
     parser.add_argument("--tail", type=int, default=0,
                         help="print only the last N records per host "
                              "(one line each) instead of the report")
+    parser.add_argument("--bench-jsonl", action="store_true",
+                        help="emit the stream's `bench` events as "
+                             "bench-history.jsonl lines (payload only, "
+                             "envelope stripped) — the history file is "
+                             "derivable from telemetry")
     args = parser.parse_args(argv)
 
     events = read_events(args.paths)
@@ -60,7 +69,14 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
-    if args.tail > 0:
+    if args.bench_jsonl:
+        from dalle_pytorch_tpu.obs.telemetry import ENVELOPE_KEYS
+
+        lines = [json.dumps({k: v for k, v in r.items()
+                             if k not in ENVELOPE_KEYS})
+                 for r in events if r.get("kind") == "bench"]
+        out = "\n".join(lines) + ("\n" if lines else "")
+    elif args.tail > 0:
         hosts = sorted({(r.get("run"), r.get("host", 0)) for r in events})
         lines = []
         for run, host in hosts:

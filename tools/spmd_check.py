@@ -101,18 +101,16 @@ HARNESSED_FACTORIES = frozenset(("vae", "dalle", "dalle_sp", "dalle_pp",
                                  "clip"))
 
 # The parallelism plans of the DALLE model (contract_check C4's matrix
-# plus pp).  mesh kwargs feed make_mesh; plan kwargs feed DALLEConfig.
-PLANS = {
-    "dp": dict(mesh=dict(), plan=dict()),
-    "fsdp": dict(mesh=dict(fsdp=4), plan=dict()),
-    "tp": dict(mesh=dict(tp=2), plan=dict()),
-    "sp-ring": dict(mesh=dict(sp=2),
-                    plan=dict(ring_axis="sp", sp_impl="ring", sp_size=2)),
-    "sp-ulysses": dict(mesh=dict(sp=2),
-                       plan=dict(ring_axis="sp", sp_impl="ulysses",
-                                 sp_size=2)),
-    "pp": dict(mesh=dict(pp=2), plan=dict()),
-}
+# plus pp) — GENERATED from the declarative plan registry
+# (parallel/plan.py), not maintained beside it: the mesh kwargs, the
+# DALLEConfig overrides, and the sharding expectations below all derive
+# from the same ParallelPlan objects the trainers run, so this harness
+# cannot drift from the production contract (ISSUE 10's single source of
+# truth).  A new registry plan lands here automatically.
+from dalle_pytorch_tpu.parallel.plan import PLAN_REGISTRY
+
+PLANS = {name: dict(mesh=p.mesh_kwargs(), plan=p.config_overrides())
+         for name, p in PLAN_REGISTRY.items()}
 
 DALLE_ARG_LABELS = ("params", "opt_state", "vae_params", "text", "codes",
                     "rng", "fault_scale")
@@ -186,7 +184,10 @@ def dalle_step_lowered(plan: str, make_cfg=cub_config, batch: int = 8):
         opt = jax.eval_shape(tx.init, params)
         lowered = step.lower(params, opt, None, text, codes, rng, fs)
     else:
-        pt = Partitioner(mesh=mesh)
+        # the Partitioner derives from the plan object itself — the same
+        # construction path the trainers take, so the shardings this
+        # analysis gates ARE the shardings production runs
+        pt = PLAN_REGISTRY[plan].partitioner(mesh=mesh)
         sharded = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
             params, pt.param_shardings(params))
@@ -386,6 +387,86 @@ def serve_retrace_check(num_slots: int = 3, **cfg_overrides):
             "prefill/admit/tick each compiled once")
 
 
+def pp_scan_schedule_check(microbatch_counts=(2, 4),
+                           microbatch_rows: int = 8) -> str:
+    """S1 for the pipeline plan's microbatch scan (PR 5 carried
+    follow-up): per-body uniformity proves each scan iteration issues one
+    lockstep collective sequence, but the pipeline's deadlock surface is
+    the TOTAL schedule — iteration count x per-iteration sequence — across
+    the GPipe scan.  Extract the schedule (``spmd.scan_collective_
+    schedule``: static because scan's trip count is static and any
+    collective under data-dependent control flow inside the body is
+    refused) and prove it is exactly ``(m + pp - 1) x seq`` with the SAME
+    per-iteration sequence at different microbatch counts — i.e. the knob
+    that shapes the schedule scales only the iteration count, never the
+    sequence the stages must agree on."""
+    spec = PLANS["pp"]
+    pp_ways = spec["mesh"]["pp"]
+    mesh = make_mesh(**spec["mesh"])
+    cfg = tiny_config(**spec["plan"])
+    dalle = DALLE(cfg)
+    tx = make_optimizer(1e-3)
+    init_text = jnp.zeros((2, cfg.text_seq_len), jnp.int32)
+    init_codes = jnp.zeros((2, cfg.image_seq_len), jnp.int32)
+    params = dalle.init(jax.random.PRNGKey(0), init_text,
+                        init_codes)["params"]
+    rng = jnp.zeros((2,), jnp.uint32)
+    fs = jnp.float32(1.0)
+
+    schedules = {}
+    for m in microbatch_counts:
+        # batch scales with m so the MICROBATCH geometry (what one scan
+        # iteration actually moves) is held constant — the comparison below
+        # is then exact down to operand shapes, not just primitive order
+        batch = microbatch_rows * m
+        text = jnp.zeros((batch, cfg.text_seq_len), jnp.int32)
+        codes = jnp.zeros((batch, cfg.image_seq_len), jnp.int32)
+        step, pp_params = make_dalle_pp_train_step(
+            dalle, tx, params, mesh, num_microbatches=m, donate=False,
+            health=True)
+        opt = jax.eval_shape(tx.init, pp_params)
+        jaxpr = jax.make_jaxpr(step)(pp_params, opt, None, text, codes,
+                                     rng, fs)
+        scans = spmd.scan_collective_schedule(jaxpr, label=f"dalle_pp/m{m}")
+        if not scans:
+            raise spmd.SPMDViolation(
+                f"S1 scan schedule [dalle_pp/m{m}]: no collective-bearing "
+                "scan found — the GPipe microbatch scan lost its stage "
+                "handoffs (or the analysis no longer sees them)")
+        # the microbatch scan is the one whose trip count is m + pp - 1
+        # (forward) — the backward scan mirrors it with the transposed
+        # collectives, so every entry must obey the same law
+        expect_len = m + pp_ways - 1
+        bad = [s for s in scans if s.length != expect_len]
+        if bad:
+            raise spmd.SPMDViolation(
+                f"S1 scan schedule [dalle_pp/m{m}]: collective-bearing "
+                f"scan(s) with trip count != microbatches + stages - 1 "
+                f"({expect_len}): "
+                + "; ".join(s.format() for s in bad))
+        schedules[m] = scans
+
+    counts = {m: len(s) for m, s in schedules.items()}
+    if len(set(counts.values())) != 1:
+        raise spmd.SPMDViolation(
+            f"S1 scan schedule [dalle_pp]: different numbers of "
+            f"collective-bearing scans across microbatch counts ({counts})")
+    m0 = microbatch_counts[0]
+    for m in microbatch_counts[1:]:
+        for a, b in zip(schedules[m0], schedules[m]):
+            if a.per_iteration != b.per_iteration:
+                raise spmd.SPMDViolation(
+                    "S1 scan schedule [dalle_pp]: the per-iteration "
+                    f"collective sequence CHANGES with the microbatch "
+                    f"count (m={m0}: {a.format()} vs m={m}: {b.format()}) "
+                    "— the schedule is not iteration-count x sequence, so "
+                    "stages disagreeing on the count deadlock")
+    detail = "; ".join(
+        f"m={m}: " + " + ".join(s.format() for s in schedules[m])
+        for m in microbatch_counts)
+    return f"schedule is (m + pp - 1) x fixed sequence — {detail}"
+
+
 def s4_drift_check(plan: str = "dp", make_cfg=cub_config,
                    temp_tol: float = 0.15) -> str:
     """S4 opt-0 drift gate (PR 5 carried follow-up): S4 budgets every plan
@@ -483,6 +564,11 @@ def run_all(chip: str = "v4-8", quick: bool = False,
     run("S1-collectives", "decode",
         lambda: "; ".join(x.format() for x in spmd.check_collective_order(
             decode_jaxpr(), label="decode")) or "no collectives")
+    # the pipeline plan's microbatch scan: iteration-count x per-iteration
+    # collective schedule, invariant across microbatch counts (the carried
+    # PR 5 follow-up — per-body uniformity alone cannot see a
+    # schedule-count mismatch between stages)
+    run("S1-scan-schedule", "dalle_pp", pp_scan_schedule_check)
     # the continuous-batching serve tick: admit/retire churn across
     # occupancies must reuse ONE executable per entry point (ISSUE 6
     # acceptance gate, chip-free twin of tests/test_serve.py); the int8
@@ -593,6 +679,16 @@ def selftest() -> int:
     spmd.check_collective_order(
         jax.make_jaxpr(fx.make_branch_matched_collective_step(mesh))(x))
     print("PASS S1 branch-matched twin: clean")
+
+    expect_catch(
+        "S1 unbalanced microbatch scan",
+        lambda: spmd.scan_collective_schedule(
+            jax.make_jaxpr(fx.make_unbalanced_microbatch_scan(mesh))(x)))
+    scheds = spmd.scan_collective_schedule(
+        jax.make_jaxpr(fx.make_pipelined_collective_scan(mesh, length=4))(x))
+    assert len(scheds) == 1 and scheds[0].length == 4 \
+        and len(scheds[0].per_iteration) == 1, scheds
+    print(f"PASS S1 pipelined-scan twin: clean ({scheds[0].format()})")
 
     tx = make_optimizer(1e-3)
     params = fx.fixture_params()

@@ -208,6 +208,15 @@ def parse_args(argv=None):
                         help="slot headroom for 'capacity' dispatch")
     parser = distributed_utils.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
+    # resolve the declarative ParallelPlan (--plan wins over the individual
+    # mesh flags and writes the resolved axis sizes back onto args) BEFORE
+    # the flag validation below, so a plan-driven sp/pp run validates the
+    # same way a flag-driven one does
+    from dalle_pytorch_tpu.parallel.plan import resolve_plan_args
+    try:
+        args.run_plan = resolve_plan_args(args)
+    except ValueError as e:
+        parser.error(str(e))
     if args.stall_timeout and not args.heartbeat_dir:
         parser.error('--stall_timeout requires --heartbeat_dir')
     if args.resume and args.dalle_path:
@@ -322,12 +331,19 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     faults.install_from_env()
 
     # crash-consistent managed checkpoints: one manifest-validated dir per
-    # save under --ckpt_dir, with retention + auto-resume fallback
+    # save under --ckpt_dir, with retention + auto-resume fallback.  Every
+    # manifest records the writing plan + topology (elastic resume
+    # provenance): a relaunch under a different --plan or device count
+    # reshards the restore and says so below.
+    from dalle_pytorch_tpu.parallel.plan import (current_topology,
+                                                 describe_transition)
     manager = (CheckpointManager(args.ckpt_dir,
                                  keep_last=args.keep_checkpoints,
                                  keep_every=args.keep_every,
                                  sharded=args.sharded_checkpoints,
-                                 async_save=args.ckpt_async)
+                                 async_save=args.ckpt_async,
+                                 plan=args.run_plan.to_manifest(),
+                                 topology=current_topology())
                if args.ckpt_every > 0 else None)
     if args.resume == 'auto':
         info = manager.latest_valid() if manager is not None else None
@@ -335,6 +351,11 @@ def _main(argv, lr_scale=1.0, skip_past=None):
             args.dalle_path = str(info.payload)
             if distr_backend.is_root_worker():
                 print(f'auto-resume: step {info.step} from {info.payload}')
+                transition = describe_transition(
+                    info.manifest.get('plan'), args.run_plan,
+                    info.manifest.get('topology'))
+                if transition:
+                    print(f'[resume] {transition}')
         elif distr_backend.is_root_worker():
             print(f'auto-resume: no valid checkpoint under {args.ckpt_dir}; '
                   'starting fresh')
@@ -490,13 +511,10 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     rng, init_rng = jax.random.split(rng)
     dummy_text = jnp.zeros((1, TEXT_SEQ_LEN), jnp.int32)
     dummy_codes = jnp.zeros((1, dalle_cfg.image_seq_len), jnp.int32)
-    if sp_plan or pp_mode:
-        from dalle_pytorch_tpu.parallel.mesh import make_mesh
-
-        part = distr_backend.distribute(mesh=make_mesh(
-            sp=args.mesh_sp, pp=args.pipeline_stages))
-    else:
-        part = distr_backend.distribute()
+    # ONE construction path for every plan (dp/fsdp/tp/dcn AND sp/pp): the
+    # resolved ParallelPlan builds the mesh and the Partitioner, and init /
+    # restore / the step-output pin all derive from that partitioner
+    part = distr_backend.distribute(plan=args.run_plan)
     if resume_sharded is not None:
         # no device allocation at all: phase 2 below restores straight onto
         # ShapeDtypeStruct templates, so an elastic resume never holds a
@@ -1125,8 +1143,13 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                     if watchdog is not None:
                         watchdog.disarm()
                     # chaos rehearsal: GRAFT_FAULTS="sigterm:at_step=N"
-                    # delivers a real preemption notice at step N
+                    # delivers a real preemption notice at step N;
+                    # "preempt:at_step=N" additionally arms the bounded
+                    # grace window (grace_ms) — miss it and the process is
+                    # hard-killed with ExitCode.PREEMPT_EXPIRED, exactly
+                    # like a scheduler's follow-up SIGKILL
                     faults.maybe_kill(global_step)
+                    faults.maybe_preempt(global_step)
                     # multi-process: the collective decision from the last
                     # flush (every process saw the same 2-vector, so every
                     # process breaks at the same step — the collective save
@@ -1178,6 +1201,10 @@ def _main(argv, lr_scale=1.0, skip_past=None):
             # join the in-flight async checkpoint write: the process must
             # not exit (or report resume state) with an uncommitted save
             manager.finish()
+        # the final save is committed (or was never started): disarm any
+        # preemption grace timer so a graceful stop that landed inside the
+        # window is not hard-killed moments after
+        faults.cancel_preempt_grace()
         if watchdog is not None:
             watchdog.close()
         if heartbeat is not None:

@@ -129,6 +129,14 @@ def parse_args(argv=None):
                              'blocking (collective)')
     parser = distributed_utils.wrap_arg_parser(parser)
     args = parser.parse_args(argv)
+    # resolve the declarative ParallelPlan (--plan wins over the individual
+    # mesh flags; the VAE trainer has no sp/pp paths, so those plans are
+    # rejected here with a real message)
+    from dalle_pytorch_tpu.parallel.plan import resolve_plan_args
+    try:
+        args.run_plan = resolve_plan_args(args)
+    except ValueError as e:
+        parser.error(str(e))
     if args.stall_timeout and not args.heartbeat_dir:
         parser.error('--stall_timeout requires --heartbeat_dir')
     if args.resume and args.resume_path:
@@ -203,12 +211,17 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     # in-process reruns (tests) see the current environment
     faults.install_from_env()
 
-    # crash-consistent managed checkpoints + auto-resume fallback
+    # crash-consistent managed checkpoints + auto-resume fallback; every
+    # manifest records the writing plan + topology (elastic resume)
+    from dalle_pytorch_tpu.parallel.plan import (current_topology,
+                                                 describe_transition)
     manager = (CheckpointManager(args.ckpt_dir,
                                  keep_last=args.keep_checkpoints,
                                  keep_every=args.keep_every,
                                  sharded=args.sharded_checkpoints,
-                                 async_save=args.ckpt_async)
+                                 async_save=args.ckpt_async,
+                                 plan=args.run_plan.to_manifest(),
+                                 topology=current_topology())
                if args.ckpt_every > 0 else None)
     if args.resume == 'auto':
         info = manager.latest_valid() if manager is not None else None
@@ -216,6 +229,11 @@ def _main(argv, lr_scale=1.0, skip_past=None):
             args.resume_path = str(info.payload)
             if distr_backend.is_root_worker():
                 print(f'auto-resume: step {info.step} from {info.payload}')
+                transition = describe_transition(
+                    info.manifest.get('plan'), args.run_plan,
+                    info.manifest.get('topology'))
+                if transition:
+                    print(f'[resume] {transition}')
         elif distr_backend.is_root_worker():
             print(f'auto-resume: no valid checkpoint under {args.ckpt_dir}; '
                   'starting fresh')
@@ -293,7 +311,9 @@ def _main(argv, lr_scale=1.0, skip_past=None):
 
     rng = jax.random.PRNGKey(0)
     rng, init_rng = jax.random.split(rng)
-    part = distr_backend.distribute()
+    # the resolved ParallelPlan builds the mesh + Partitioner: init,
+    # restore templates, and the step-output pin all derive from it
+    part = distr_backend.distribute(plan=args.run_plan)
     dummy = jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
     if resume_sharded is not None:
         # templates only: no device allocation before the direct restore
@@ -652,8 +672,11 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                                           if monitor_h is not None else {}))
                     if watchdog is not None:
                         watchdog.disarm()
-                    # chaos rehearsal: GRAFT_FAULTS="sigterm:at_step=N"
+                    # chaos rehearsal: GRAFT_FAULTS="sigterm:at_step=N";
+                    # "preempt:at_step=N" additionally arms the bounded
+                    # grace window (hard-kill on expiry)
                     faults.maybe_kill(global_step)
+                    faults.maybe_preempt(global_step)
                     # multi-process: the collective decision from the last
                     # 10-step poll (symmetric across processes, so the
                     # collective save below cannot deadlock); single-process:
@@ -679,6 +702,9 @@ def _main(argv, lr_scale=1.0, skip_past=None):
         if manager is not None:
             # join the in-flight async checkpoint write before exit
             manager.finish()
+        # final save committed (or never started): disarm the preemption
+        # grace timer so a graceful stop inside the window stays clean
+        faults.cancel_preempt_grace()
         if watchdog is not None:
             watchdog.close()
         if heartbeat is not None:
