@@ -395,6 +395,52 @@ def rule_obs001(ctx: FileCtx) -> Iterator[RuleHit]:
             yield node, msg
 
 
+# --- OBS002: wall-clock duration math -------------------------------------
+
+
+def _is_time_time(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) \
+        and _attr_chain(node.func) == "time.time"
+
+
+def rule_obs002(ctx: FileCtx) -> Iterator[RuleHit]:
+    """``time.time() - t0`` measures a duration with a clock that NTP can
+    step backwards mid-run and that skews by seconds across a fleet — the
+    exact wobble obs/align.py exists to undo.  Inside
+    ``dalle_pytorch_tpu/``, durations must come from ``time.monotonic()``
+    (or ``perf_counter``); wall clock is reserved for envelope timestamps
+    (telemetry ``t``, heartbeat ``time``) that cross processes.  Flags a
+    subtraction whose operand is a direct ``time.time()`` call or a name
+    assigned from one in the same scope; genuinely cross-clock math
+    (wall vs a file mtime) carries a pragma saying so.  Aliased imports
+    escape — the usual syntactic over-approximation contract."""
+    msg = ("duration math on a time.time() delta: wall clocks skew across "
+           "hosts and NTP can step them mid-run; use time.monotonic() for "
+           "durations (wall clock is for envelope timestamps only), or "
+           "pragma with why this subtraction is genuinely cross-clock")
+    parts = tuple(ctx.path.replace("\\", "/").split("/"))
+    if "dalle_pytorch_tpu" not in parts:
+        return
+    scopes = [ctx.tree] + [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for scope in scopes:
+        wall_names = {
+            node.targets[0].id
+            for node in _walk_skip_defs(scope)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and _is_time_time(node.value)}
+        for node in _walk_skip_defs(scope):
+            if not isinstance(node, ast.BinOp) \
+                    or not isinstance(node.op, ast.Sub):
+                continue
+            if any(_is_time_time(side)
+                   or (isinstance(side, ast.Name) and side.id in wall_names)
+                   for side in (node.left, node.right)):
+                yield node, msg
+
+
 # --- DON001/DON002: buffer donation (the AST side of graftspmd S2) --------
 
 _STEP_FACTORY_RE = re.compile(r"^make_\w*step\w*$")
@@ -639,6 +685,7 @@ RULES = {
     "EXC001": rule_exc001,
     "CKPT001": rule_ckpt001,
     "OBS001": rule_obs001,
+    "OBS002": rule_obs002,
     "DON001": rule_don001,
     "DON002": rule_don002,
 }

@@ -1,19 +1,29 @@
-"""graftscope: unified run telemetry (DESIGN.md §14).
+"""graftscope: unified run telemetry (DESIGN.md §14) + the fleet layer
+(DESIGN.md §16).
 
 ``telemetry`` is the write side (run-scoped JSONL event stream + spans +
 the module-level singleton every layer emits into), ``report`` and
 ``trace_export`` are the read side (run report, Perfetto/Chrome trace).
-Stdlib-only by design: the stream must be writable and readable on a box
-whose accelerator tunnel is wedged.
+The fleet layer rides the same stream: ``align`` solves per-host clock
+models from beacons and merges N streams onto one timebase, ``metrics``
+exposes an in-process /metrics + /healthz endpoint fed by the emit path,
+and ``alerts`` evaluates declarative threshold/burn-rate rules over
+sliding windows, emitting ``alert`` events back into the stream.
+Stdlib-only by design: every half of this must be writable and readable
+on a box whose accelerator tunnel is wedged.
 """
-from . import telemetry
-from .report import build_report, render_text
-from .telemetry import (EVENT_SCHEMA, SCHEMA_VERSION, Telemetry, emit, get,
-                        init, note, read_events, shutdown, span)
+from . import align, alerts, metrics, telemetry
+from .align import LaneClock, merge_streams, solve_alignment
+from .report import build_fleet_report, build_report, render_text
+from .telemetry import (EVENT_SCHEMA, SCHEMA_VERSION, Telemetry,
+                        clock_beacon_payload, emit, get, init, note,
+                        read_events, shutdown, span)
 from .trace_export import to_chrome_trace
 
 __all__ = [
-    "telemetry", "Telemetry", "EVENT_SCHEMA", "SCHEMA_VERSION",
-    "init", "get", "shutdown", "emit", "span", "note", "read_events",
-    "build_report", "render_text", "to_chrome_trace",
+    "telemetry", "align", "alerts", "metrics", "Telemetry", "EVENT_SCHEMA",
+    "SCHEMA_VERSION", "init", "get", "shutdown", "emit", "span", "note",
+    "read_events", "clock_beacon_payload", "build_report",
+    "build_fleet_report", "render_text", "to_chrome_trace", "LaneClock",
+    "merge_streams", "solve_alignment",
 ]

@@ -1,0 +1,214 @@
+"""Declarative alerting over the telemetry stream.
+
+A run that is sick but not dead is the failure mode nothing earlier
+catches: heartbeats age only when the process stops, guardrails only see
+non-finite/spiking losses, and the stream records a stall faithfully
+without ever *saying* anything.  This module closes that gap with a small
+set of declarative rules evaluated over sliding windows of the event
+stream itself:
+
+* **threshold** — the windowed mean of a sampled field crosses a limit
+  (``stall_fraction``: the step loop is input-bound; ``slo_attainment``:
+  the serve burn-rate shape — attainment is a success ratio, so a
+  windowed mean below target IS the burn).
+* **ratio_of_median** — the windowed mean falls below a fraction of the
+  run's own median so far (``mfu_drop``: a straggler or a thermally
+  throttled chip reads as "slower than this very run used to be", no
+  absolute threshold needed).
+* **rate** — more than N matching events inside the window
+  (``quarantine_rate``: the data diet is rotting faster than the
+  per-sample policy can hide).
+* **gap** — the monotonic distance between consecutive matching records
+  exceeds a limit (``heartbeat_gap``: the stream went quiet mid-run; the
+  in-process engine sees it when the next record finally lands, the
+  monitor's fleet scan sees it live from outside).
+
+The engine is pure (observe records in, fired alerts out) and stdlib-only;
+``Telemetry.attach_alerts`` wires it into the emit path so a fired alert
+is emitted back into the SAME stream as an ``alert`` event — with a seq
+strictly after the record that tripped it, which is what lets chaos tests
+assert cause -> alert ordering from the stream alone — and printed via the
+``note()`` operator line.  ``tools/monitor.py --fleet`` runs the same
+rules offline over N hosts' stream tails.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One declarative alert rule.
+
+    ``select_kind``/``select_names`` pick the records the rule samples
+    (span markers are always skipped); ``field`` names the payload value
+    sampled (None counts 1.0 per match; bools coerce to 0/1).  ``kind``
+    picks the evaluation: threshold (window mean ``op`` ``limit``),
+    ratio_of_median (window mean < ``ratio`` x run median),
+    rate (window count > ``limit``), gap (mono gap > ``limit``).
+    ``cooldown_s`` bounds re-firing so a sustained condition is one alert
+    per cooldown, not one per record."""
+
+    name: str
+    kind: str
+    select_kind: str
+    select_names: Optional[Tuple[str, ...]] = None
+    field: Optional[str] = None
+    op: str = ">"
+    limit: float = 0.0
+    ratio: float = 0.0
+    window_s: float = 60.0
+    min_count: int = 3
+    cooldown_s: float = 300.0
+    describe: str = ""
+
+
+DEFAULT_RULES: Tuple[Rule, ...] = (
+    Rule(name="stall_fraction", kind="threshold", select_kind="step",
+         field="loader_stall_frac", op=">", limit=0.5, window_s=60.0,
+         min_count=5,
+         describe="input pipeline stalls dominate the step loop"),
+    Rule(name="mfu_drop", kind="ratio_of_median", select_kind="step",
+         field="mfu", ratio=0.6, window_s=120.0, min_count=5,
+         describe="MFU fell well below this run's own median"),
+    Rule(name="slo_attainment", kind="threshold", select_kind="serve",
+         select_names=("retire",), field="slo_ok", op="<", limit=0.9,
+         window_s=120.0, min_count=10,
+         describe="serve SLO attainment burning below target"),
+    Rule(name="quarantine_rate", kind="rate", select_kind="data",
+         select_names=("sample_quarantine", "shard_quarantine"),
+         limit=5.0, window_s=300.0, min_count=1,
+         describe="inputs quarantining faster than a rotten few"),
+    Rule(name="heartbeat_gap", kind="gap", select_kind="step",
+         limit=120.0, window_s=0.0, min_count=1, cooldown_s=60.0,
+         describe="the stream went quiet between steps"),
+)
+
+
+class _RuleState:
+    __slots__ = ("window", "history", "last_match_mono", "last_fire_mono")
+
+    def __init__(self):
+        self.window: Deque[Tuple[float, float]] = deque()  # (mono, value)
+        self.history: List[float] = []       # all-time samples (median)
+        self.last_match_mono: Optional[float] = None
+        self.last_fire_mono: Optional[float] = None
+
+
+def _cmp(value: float, op: str, limit: float) -> bool:
+    return value > limit if op == ">" else value < limit
+
+
+class AlertEngine:
+    """Feed records in causal order (one host's stream); collect fired
+    alerts.  ``active`` keeps the latest firing per rule — what the
+    monitor's fleet scan prints."""
+
+    def __init__(self, rules: Tuple[Rule, ...] = DEFAULT_RULES):
+        self.rules = tuple(rules)
+        self._state: Dict[str, _RuleState] = {
+            r.name: _RuleState() for r in self.rules}
+        self.active: Dict[str, dict] = {}
+
+    def observe(self, rec: dict) -> List[dict]:
+        """Evaluate every rule against one record; returns the alerts that
+        fired (payload dicts ready to ride an ``alert`` event).  Ignores
+        span markers and the alert/clock kinds (no self-triggering)."""
+        kind = rec.get("kind")
+        if kind in ("alert", "clock") or "ph" in rec:
+            return []
+        mono = rec.get("mono")
+        if mono is None:
+            return []
+        mono = float(mono)
+        fired: List[dict] = []
+        for rule in self.rules:
+            alert = self._observe_one(rule, rec, kind, mono)
+            if alert is not None:
+                self.active[rule.name] = alert
+                fired.append(alert)
+        return fired
+
+    # --- internals --------------------------------------------------------
+
+    def _observe_one(self, rule: Rule, rec: dict, kind: str,
+                     mono: float) -> Optional[dict]:
+        st = self._state[rule.name]
+        matched = (kind == rule.select_kind
+                   and (rule.select_names is None
+                        or rec.get("name") in rule.select_names))
+        value: Optional[float] = None
+        if matched:
+            if rule.field is None:
+                value = 1.0
+            else:
+                raw = rec.get(rule.field)
+                if raw is None:
+                    matched = False
+                else:
+                    value = float(raw)
+        gap = None
+        if matched:
+            if st.last_match_mono is not None:
+                gap = mono - st.last_match_mono
+            st.last_match_mono = mono
+            st.window.append((mono, value))
+            st.history.append(value)
+        # evict by the OBSERVED clock, so a rule's window drains even on
+        # records it does not sample
+        while st.window and mono - st.window[0][0] > rule.window_s:
+            st.window.popleft()
+
+        verdict = self._evaluate(rule, st, gap)
+        if verdict is None:
+            return None
+        if st.last_fire_mono is not None \
+                and mono - st.last_fire_mono < rule.cooldown_s:
+            return None
+        st.last_fire_mono = mono
+        measured, msg = verdict
+        return {
+            "rule": rule.name, "value": round(measured, 6),
+            "limit": rule.limit if rule.kind != "ratio_of_median"
+            else rule.ratio,
+            "window_s": rule.window_s, "window_n": len(st.window),
+            "cause_seq": rec.get("seq"), "cause_kind": kind,
+            "cause_name": rec.get("name"),
+            "msg": f"{rule.name}: {msg}"
+                   + (f" — {rule.describe}" if rule.describe else ""),
+        }
+
+    def _evaluate(self, rule: Rule, st: _RuleState,
+                  gap: Optional[float]) -> Optional[Tuple[float, str]]:
+        if rule.kind == "gap":
+            if gap is not None and gap > rule.limit:
+                return gap, f"{gap:.1f}s without a matching record " \
+                            f"(limit {rule.limit:g}s)"
+            return None
+        if len(st.window) < rule.min_count:
+            return None
+        values = [v for _, v in st.window]
+        if rule.kind == "rate":
+            n = float(len(values))
+            if n > rule.limit:
+                return n, f"{int(n)} events in {rule.window_s:g}s " \
+                          f"(limit {rule.limit:g})"
+            return None
+        mean = sum(values) / len(values)
+        if rule.kind == "threshold":
+            if _cmp(mean, rule.op, rule.limit):
+                return mean, f"window mean {mean:.4g} {rule.op} " \
+                             f"limit {rule.limit:g}"
+            return None
+        if rule.kind == "ratio_of_median":
+            if len(st.history) < 2 * rule.min_count:
+                return None
+            ordered = sorted(st.history)
+            median = ordered[len(ordered) // 2]
+            if median > 0 and mean < rule.ratio * median:
+                return mean, f"window mean {mean:.4g} < " \
+                             f"{rule.ratio:g} x run median {median:.4g}"
+            return None
+        raise ValueError(f"unknown rule kind {rule.kind!r}")

@@ -1,0 +1,292 @@
+"""Cross-host clock alignment: merge N per-host streams onto one timebase.
+
+Each host stamps its records with its OWN wall clock (envelope ``t``) and
+its OWN monotonic clock (``mono``).  Within a host the monotonic clock is
+authoritative for durations; across hosts nothing is: wall clocks skew by
+seconds and drift by ms/minute, so a naive merge puts host B's step 40
+before host A's step 39 and every cross-host latency reads as noise.
+This module estimates, per (run, host) lane, a clock model
+
+    fleet_t  =  t  -  (offset + drift * (mono - mono0))
+
+and rewrites timestamps through it, so ``trace_export`` can put N hosts
+on one Perfetto timeline (one pid lane per host) and ``report`` can build
+a fleet view (straggler ranking, merged SLO attainment) whose cross-host
+deltas mean something.
+
+Anchor sources, best first:
+
+1. **Rendezvous beacons** — ``clock.beacon`` records carrying ``ref``: a
+   shared filesystem's mtime clock observed at the beacon (armed by
+   ``GRAFT_CLOCK_RDV`` or ``Telemetry.rendezvous``).  Every host that has
+   them aligns to the fs clock independently: works for hosts with no
+   common workload at all (disjoint serve replicas).
+2. **Matched step anchors** — in a data-parallel fleet, global step k
+   completes on every host at (collective-bounded) the same instant, so
+   per-step wall times pair across hosts: offset = median of the pairwise
+   deltas vs the reference lane, drift fit over the host's mono axis when
+   the anchors span enough time.
+3. **Fallback** — align the lanes' first records and say so (``method:
+   "fallback"``, unbounded residual): a merge is still more readable than
+   N disjoint files, but the report marks it untrusted.
+
+Every lane reports a **residual-skew bound**: the max |residual| of its
+anchors after the fit (floored at 1 ms for single-anchor fits).  The
+fleet report prints it; the acceptance test asserts recovered skew stays
+inside it.  Stdlib-only, like the rest of ``obs``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import time
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .telemetry import read_events
+
+# a single rendezvous/step anchor still carries clock-resolution +
+# scheduling jitter; never report a bound tighter than this
+MIN_BOUND_S = 1e-3
+
+# one stream lane.  The leading elements disambiguate the SOURCE (merge
+# prepends the path index: two --merge dirs are two hosts even when both
+# trainers picked the same timestamp-derived run id); the last two are
+# always (run id, host index).
+LaneKey = Tuple[str, int]
+
+
+@dataclasses.dataclass
+class LaneClock:
+    """One lane's solved clock model + its provenance."""
+
+    run: str
+    orig_host: int
+    lane: int                 # fleet host index (pid lane after merge)
+    offset: float = 0.0       # seconds this lane's wall clock runs ahead
+    drift: float = 0.0        # d(offset)/d(mono): seconds of skew per second
+    mono0: float = 0.0        # mono origin the drift term is anchored at
+    bound: Optional[float] = 0.0   # residual-skew bound; None = unbounded
+    method: str = "reference"
+    anchors: int = 0
+    boot: Optional[str] = None
+
+    def fleet_t(self, t: float, mono: Optional[float]) -> float:
+        m = self.mono0 if mono is None else float(mono)
+        return float(t) - (self.offset + self.drift * (m - self.mono0))
+
+    def summary(self) -> dict:
+        return {"run": self.run, "host": self.orig_host, "lane": self.lane,
+                "offset_s": round(self.offset, 6),
+                "drift_s_per_s": round(self.drift, 9),
+                "residual_bound_s": (None if self.bound is None
+                                     else round(self.bound, 6)),
+                "method": self.method, "anchors": self.anchors,
+                "boot": self.boot}
+
+
+def _lane_key(rec: dict) -> LaneKey:
+    return str(rec.get("run", "")), int(rec.get("host", 0))
+
+
+def split_lanes(events: Iterable[dict]) -> "Dict[LaneKey, List[dict]]":
+    """Group parsed records into per-(run, host) lanes, insertion-ordered
+    (dict preserves it), each lane already seq-ordered by read_events."""
+    lanes: Dict[LaneKey, List[dict]] = {}
+    for rec in events:
+        lanes.setdefault(_lane_key(rec), []).append(rec)
+    return lanes
+
+
+def _fit(deltas: Sequence[float], monos: Sequence[float]
+         ) -> Tuple[float, float, float, float]:
+    """Fit delta = offset + drift*(mono - mono0); returns (offset, drift,
+    mono0, bound).  Drift only enters with >= 3 anchors spanning > 1 s of
+    mono — below that a line through noise invents drift that is worse
+    than none."""
+    mono0 = monos[0] if monos else 0.0
+    ordered = sorted(deltas)
+    offset = ordered[len(ordered) // 2]
+    drift = 0.0
+    span = (max(monos) - min(monos)) if monos else 0.0
+    if len(deltas) >= 3 and span > 1.0:
+        xs = [m - mono0 for m in monos]
+        n = float(len(xs))
+        mx = sum(xs) / n
+        my = sum(deltas) / n
+        var = sum((x - mx) ** 2 for x in xs)
+        if var > 0:
+            drift = sum((x - mx) * (d - my)
+                        for x, d in zip(xs, deltas)) / var
+            offset = my - drift * mx
+    resid = [abs(d - (offset + drift * (m - mono0)))
+             for d, m in zip(deltas, monos)]
+    return offset, drift, mono0, max([MIN_BOUND_S] + resid)
+
+
+def _rendezvous_anchors(lane: List[dict]) -> Tuple[List[float], List[float]]:
+    """(delta, mono) pairs from ref-bearing beacons: delta = the lane's
+    wall reading minus the shared-fs reference at the same instant."""
+    deltas, monos = [], []
+    for r in lane:
+        if r.get("kind") == "clock" and r.get("ref") is not None:
+            wall = r.get("wall", r.get("t"))
+            if wall is None or r.get("mono") is None:
+                continue
+            deltas.append(float(wall) - float(r["ref"]))
+            monos.append(float(r["mono"]))
+    return deltas, monos
+
+
+def _step_times(lane: List[dict]) -> "Dict[int, Tuple[float, float]]":
+    """step id -> (t, mono) of the FIRST step record for it (resume
+    re-emissions would otherwise smear the anchor)."""
+    out: Dict[int, Tuple[float, float]] = {}
+    for r in lane:
+        if r.get("kind") != "step" or "ph" in r:
+            continue
+        s, t, m = r.get("step"), r.get("t"), r.get("mono")
+        if s is None or t is None or m is None:
+            continue
+        out.setdefault(int(s), (float(t), float(m)))
+    return out
+
+
+def solve_alignment(lanes: "Dict[LaneKey, List[dict]]"
+                    ) -> "Dict[LaneKey, LaneClock]":
+    """Solve one clock model per lane.  Lane order fixes the fleet host
+    indices; the first lane without rendezvous anchors becomes the step-
+    matching reference (offset 0 by definition — the fleet timebase is
+    either the shared-fs clock, when rendezvous exists, or the reference
+    lane's wall clock)."""
+    clocks: Dict[LaneKey, LaneClock] = {}
+    keys = list(lanes)
+    for i, key in enumerate(keys):
+        lane = lanes[key]
+        boot = next((r.get("boot") for r in lane
+                     if r.get("kind") == "clock" and r.get("boot")), None)
+        clocks[key] = LaneClock(run=str(key[-2]), orig_host=int(key[-1]),
+                                lane=i, boot=boot)
+
+    # pass 1: rendezvous lanes align to the shared-fs clock directly
+    aligned: set = set()
+    for key in keys:
+        deltas, monos = _rendezvous_anchors(lanes[key])
+        if deltas:
+            off, drift, mono0, bound = _fit(deltas, monos)
+            clocks[key] = dataclasses.replace(
+                clocks[key], offset=off, drift=drift, mono0=mono0,
+                bound=bound, method="rendezvous", anchors=len(deltas))
+            aligned.add(key)
+
+    # pass 2: remaining lanes match step anchors against a reference lane
+    # (prefer an already-aligned one, so mixed fleets share one timebase)
+    remaining = [k for k in keys if k not in aligned]
+    if not remaining:
+        return clocks
+    ref_key = next((k for k in keys if k in aligned), remaining[0])
+    ref_clock = clocks[ref_key]
+    ref_steps = _step_times(lanes[ref_key])
+    for key in remaining:
+        if key == ref_key:
+            clocks[key] = dataclasses.replace(
+                clocks[key], method="reference", anchors=len(ref_steps))
+            continue
+        steps = _step_times(lanes[key])
+        common = sorted(set(steps) & set(ref_steps))
+        if common:
+            # pair against the reference on the FLEET timebase, so a
+            # rendezvous-aligned reference still anchors step-only lanes
+            deltas = [steps[s][0]
+                      - ref_clock.fleet_t(*ref_steps[s]) for s in common]
+            monos = [steps[s][1] for s in common]
+            off, drift, mono0, bound = _fit(deltas, monos)
+            clocks[key] = dataclasses.replace(
+                clocks[key], offset=off, drift=drift, mono0=mono0,
+                bound=bound, method="steps", anchors=len(common))
+            continue
+        # fallback: align first records, report the bound as unknown
+        lane = lanes[key]
+        t0 = next((r.get("t") for r in lane if r.get("t") is not None), None)
+        ref0 = next((ref_clock.fleet_t(r["t"], r.get("mono"))
+                     for r in lanes[ref_key] if r.get("t") is not None),
+                    None)
+        off = (float(t0) - float(ref0)) if t0 is not None \
+            and ref0 is not None else 0.0
+        clocks[key] = dataclasses.replace(
+            clocks[key], offset=off, bound=None, method="fallback",
+            anchors=0)
+    return clocks
+
+
+def align_lane(lane: List[dict], clock: LaneClock) -> List[dict]:
+    """Rewrite one lane's records onto the fleet timebase: ``t`` becomes
+    fleet time (the host's raw stamp survives as ``t_raw``), ``host``
+    becomes the fleet lane index (the stream's own index survives as
+    ``orig_host``) — so downstream consumers (report, trace_export) need
+    no changes to see one host per lane."""
+    out = []
+    for r in lane:
+        r2 = dict(r)
+        t = r.get("t")
+        if t is not None:
+            r2["t_raw"] = t
+            r2["t"] = clock.fleet_t(float(t), r.get("mono"))
+        r2["orig_host"] = r.get("host", 0)
+        r2["host"] = clock.lane
+        out.append(r2)
+    return out
+
+
+def heartbeat_offsets(hb_dir) -> "Dict[int, dict]":
+    """Monitor-side anchors from heartbeat files: each
+    ``heartbeat-p{i}.json`` carries the clock payload (wall/mono/boot —
+    utils/failure.py rides it on every beat) and the FILE's mtime is the
+    monitor-side filesystem clock at the moment of the write, a
+    rendezvous-grade common reference.  ``offset = payload wall - mtime``
+    places the host on the monitor's timebase even when the host died
+    between telemetry rotations and its stream has no surviving beacon.
+    Returns {process index: {offset, boot, age_s}}."""
+    out: Dict[int, dict] = {}
+    now = time.time()
+    for p in Path(hb_dir).glob("heartbeat-p*.json"):
+        m = re.fullmatch(r"heartbeat-p(\d+)", p.stem)
+        if not m:
+            continue
+        try:
+            info = json.loads(p.read_text())
+            mtime = p.stat().st_mtime
+        except (OSError, ValueError):
+            continue
+        clock = info.get("clock")
+        if not isinstance(clock, dict) or clock.get("wall") is None:
+            continue
+        out[int(m.group(1))] = {
+            "offset": float(clock["wall"]) - float(mtime),
+            "boot": clock.get("boot"),
+            # graftlint: disable=OBS002 (cross-clock by design: heartbeat mtime is wall material; a monotonic reading cannot compare against it)
+            "age_s": now - float(mtime),
+        }
+    return out
+
+
+def merge_streams(paths: Sequence) -> Tuple[List[dict], List[LaneClock]]:
+    """The ``obs_report --merge`` entry: read each path (stream dir or
+    file, rotated parts included), solve the fleet clock model, and
+    return (aligned records sorted on the fleet timebase, lane clocks).
+    Lane indices follow path order, then host order inside a path."""
+    lanes: Dict[tuple, List[dict]] = {}
+    for i, p in enumerate(paths):
+        for key, lane in split_lanes(read_events(p)).items():
+            # the path index keeps two sources apart even when both
+            # trainers derived the same timestamp run id (the concurrent-
+            # launch collision the CI fleet smoke hits)
+            lanes.setdefault((i,) + key, []).extend(lane)
+    clocks = solve_alignment(lanes)
+    merged: List[dict] = []
+    for key, lane in lanes.items():
+        merged.extend(align_lane(lane, clocks[key]))
+    merged.sort(key=lambda r: (r.get("t", 0.0), r.get("host", 0),
+                               r.get("seq", 0)))
+    return merged, [clocks[k] for k in lanes]

@@ -207,6 +207,72 @@ def build_report(events: List[dict]) -> dict:
     }
 
 
+def build_fleet_report(events: List[dict], clocks) -> dict:
+    """The fleet view over ALIGNED, merged records (``align.merge_streams``
+    output): everything :func:`build_report` aggregates — serve p50/p99
+    and attainment per SLO class, ckpt/fault/quarantine rollups — now
+    spans every host, plus the cross-host sections only an aligned
+    timebase makes meaningful:
+
+    * per-lane clock provenance (offset/drift/residual bound/method),
+    * the global step timeline: for every step seen on >= 2 lanes, the
+      fleet-time spread between the first and last host to log it,
+    * straggler ranking: lanes ordered by their mean lag behind the
+      fastest host at each common step,
+    * active-alert rollup per lane.
+    """
+    rep = build_report(events)
+
+    by_lane: Dict[int, List[dict]] = {}
+    for r in events:
+        by_lane.setdefault(int(r.get("host", 0)), []).append(r)
+
+    lane_rows = []
+    for c in clocks:
+        lane = by_lane.get(c.lane, [])
+        steps = [r for r in lane if r.get("kind") == "step"
+                 and "ph" not in r and r.get("step") is not None]
+        alerts = [r.get("name") for r in lane if r.get("kind") == "alert"]
+        lane_rows.append(dict(
+            c.summary(), records=len(lane),
+            last_step=max((int(r["step"]) for r in steps), default=None),
+            alerts=sorted(set(alerts)), alert_count=len(alerts)))
+
+    # step timeline on the fleet timebase
+    step_t: Dict[int, Dict[int, float]] = {}
+    for r in events:
+        if r.get("kind") != "step" or "ph" in r or r.get("step") is None \
+                or r.get("t") is None:
+            continue
+        per = step_t.setdefault(int(r["step"]), {})
+        per.setdefault(int(r.get("host", 0)), float(r["t"]))
+    common = {s: per for s, per in step_t.items() if len(per) >= 2}
+    spreads = sorted((max(per.values()) - min(per.values()))
+                     for per in common.values())
+    lags: Dict[int, List[float]] = {}
+    for per in common.values():
+        first = min(per.values())
+        for lane, t in per.items():
+            lags.setdefault(lane, []).append(t - first)
+    stragglers = sorted(
+        ({"lane": lane, "mean_lag_s": sum(v) / len(v),
+          "max_lag_s": max(v), "steps": len(v)}
+         for lane, v in lags.items()),
+        key=lambda row: -row["mean_lag_s"])
+    last_steps = [row["last_step"] for row in lane_rows
+                  if row["last_step"] is not None]
+    rep["fleet"] = {
+        "lanes": lane_rows,
+        "common_steps": len(common),
+        "step_spread_p50_s": _pct(spreads, 50),
+        "step_spread_max_s": spreads[-1] if spreads else None,
+        "stragglers": stragglers,
+        "steps_behind": (max(last_steps) - min(last_steps)
+                         if len(last_steps) > 1 else None),
+    }
+    return rep
+
+
 def _fmt(v, nd: int = 4) -> str:
     if v is None:
         return "-"
@@ -301,4 +367,29 @@ def render_text(report: dict) -> str:
         for t in report["torn_spans"][:10]:
             lines.append(f"  {t['kind']}.{t['name']} host {t['host']} "
                          f"seq {t['seq']}")
+
+    fleet = report.get("fleet")
+    if fleet:
+        lines.append("-- fleet (aligned timebase) --")
+        for lane in fleet["lanes"]:
+            bound = lane["residual_bound_s"]
+            lines.append(
+                f"  lane {lane['lane']} = {lane['run']} "
+                f"(host {lane['host']}): offset {_fmt(lane['offset_s'])}s "
+                f"drift {_fmt(lane['drift_s_per_s'])}/s "
+                f"±{'unbounded' if bound is None else _fmt(bound) + 's'} "
+                f"[{lane['method']}, {lane['anchors']} anchors], "
+                f"last step {lane['last_step']}"
+                + (f", ALERTS: {', '.join(lane['alerts'])}"
+                   if lane["alerts"] else ""))
+        lines.append(
+            f"step timeline: {fleet['common_steps']} common steps, "
+            f"spread p50 {_fmt(fleet['step_spread_p50_s'])}s / max "
+            f"{_fmt(fleet['step_spread_max_s'])}s, steps behind "
+            f"{_fmt(fleet['steps_behind'])}")
+        for row in fleet["stragglers"][:5]:
+            lines.append(
+                f"  straggler lane {row['lane']}: mean lag "
+                f"{_fmt(row['mean_lag_s'])}s (max {_fmt(row['max_lag_s'])}s "
+                f"over {row['steps']} steps)")
     return "\n".join(lines) + "\n"

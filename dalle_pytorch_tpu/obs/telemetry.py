@@ -96,6 +96,25 @@ def _env_disabled() -> bool:
     return val.strip().lower() in ("", "0", "false", "no", "off")
 
 
+def _env_clock_skew() -> float:
+    """``GRAFT_CLOCK_SKEW_S``: test-only wall-clock skew injection (added
+    to every envelope ``t`` and beacon ``wall`` this process stamps) so
+    chaos/CI runs can rehearse a fleet whose hosts disagree about the
+    time — the exact condition ``align.py``'s solver must undo.  Never
+    set in production; real skew comes free."""
+    try:
+        return float(os.environ.get("GRAFT_CLOCK_SKEW_S", ""))
+    except ValueError:  # unset, empty, or junk: no injected skew
+        return 0.0
+
+
+# per-process boot nonce: names THIS process's monotonic clock, because a
+# monotonic reading is only comparable to another from the same boot of
+# the same process — heartbeats and clock beacons both carry it so the
+# offset solver never pairs mono values across a restart
+_BOOT = f"{os.getpid():x}-{time.time_ns() & 0xFFFFFFFFFF:010x}"
+
+
 class _NullSpan:
     """Shared no-op context manager: the disabled ``span()`` path returns
     this singleton — no per-call allocation."""
@@ -155,11 +174,33 @@ class Telemetry:
 
     def __init__(self, directory, run_id: Optional[str] = None, *,
                  host: int = 0, rotate_bytes: int = 64 << 20,
-                 keep_rotated: int = 4, enabled: bool = True):
+                 keep_rotated: int = 4, beacon_every: int = 256,
+                 enabled: bool = True):
         self.host = int(host)
         self.pid = os.getpid()
+        self.boot = _BOOT
         self.rotate_bytes = int(rotate_bytes)
         self.keep_rotated = int(keep_rotated)
+        # clock beacons: every `beacon_every` records (and on the first
+        # one) a `clock.beacon` rides the stream — the wall<->monotonic
+        # offset pair + boot nonce align.py's solver runs on, re-emitted
+        # periodically so rotation pruning never drops the last one.
+        # 0 disables (tests that pin exact stream shapes).
+        self.beacon_every = int(beacon_every)
+        self._last_beacon = -self.beacon_every  # first event emits one
+        self._clock_skew = _env_clock_skew()
+        # shared-file rendezvous dir (GRAFT_CLOCK_RDV): when set, beacons
+        # also carry `ref` = a shared filesystem's mtime clock, giving
+        # hosts with no common workload a common reference (see
+        # rendezvous())
+        # graftlint: disable=ENV001 (GRAFT_CLOCK_RDV is a path: truthiness here is presence-of-value, not a boolean flag)
+        self._rdv_dir = os.environ.get("GRAFT_CLOCK_RDV") or None
+        # optional attach points (see attach_metrics / attach_alerts):
+        # None keeps the emit path allocation-free, exactly like the
+        # GRAFT_TELEMETRY=0 contract
+        self._metrics = None
+        self._alerts = None
+        self._in_hook = False
         self._lock = threading.RLock()
         self._seq = 0
         self._fd: Optional[int] = None
@@ -214,7 +255,7 @@ class Telemetry:
             seq = self._seq
             rec = dict(fields)
             rec.update(v=SCHEMA_VERSION, run=self.run_id, host=self.host,
-                       pid=self.pid, seq=seq, t=time.time(),
+                       pid=self.pid, seq=seq, t=time.time() + self._clock_skew,
                        mono=time.monotonic(),
                        thread=threading.current_thread().name,
                        kind=kind, name=name)
@@ -229,6 +270,25 @@ class Telemetry:
             self._bytes += len(line)
             if self._bytes > self.rotate_bytes:
                 self._rotate_locked()
+            # attach hooks: the metrics feed and the alert engine both see
+            # the record AFTER it landed, so anything they emit (an alert
+            # record) gets a LATER seq — causally ordered after its cause.
+            # The metrics feed never emits, so it runs unguarded (and
+            # therefore counts nested alert records too); `_in_hook`
+            # keeps the alert engine out of its own emissions.  Detached
+            # (None) hooks cost one attribute check — the same
+            # free-when-off contract as GRAFT_TELEMETRY=0.
+            if self._metrics is not None:
+                self._metrics.observe_event(rec)
+            if self._alerts is not None and not self._in_hook:
+                self._in_hook = True
+                try:
+                    self._fire_alerts_locked(self._alerts.observe(rec))
+                finally:
+                    self._in_hook = False
+            if self.beacon_every > 0 \
+                    and seq - self._last_beacon >= self.beacon_every:
+                self._emit_beacon_locked()
         return seq
 
     def span(self, kind: str, name: str, **fields):
@@ -236,6 +296,81 @@ class Telemetry:
         if self._fd is None:
             return _NULL_SPAN
         return _Span(self, kind, name, fields)
+
+    # --- fleet clock model (align.py's write side) ------------------------
+
+    def clock_beacon(self) -> dict:
+        """This instant's wall<->monotonic offset pair + boot nonce — the
+        payload `clock.beacon` records and heartbeats carry so the offset
+        solver can place this host on the fleet timebase even when the
+        host dies between telemetry rotations."""
+        return {"wall": time.time() + self._clock_skew,
+                "mono": time.monotonic(), "boot": self.boot}
+
+    def _emit_beacon_locked(self) -> None:
+        """Emit one `clock.beacon` record (called with the lock held; the
+        cadence counter is advanced FIRST so the beacon's own event() call
+        cannot recurse)."""
+        self._last_beacon = self._seq + 1
+        payload = self.clock_beacon()
+        if self._rdv_dir is not None:
+            ref = self._rendezvous_ref()
+            if ref is not None:
+                payload["ref"] = ref
+        self.event("clock", "beacon", **payload)
+
+    def _rendezvous_ref(self) -> Optional[float]:
+        """Shared-file rendezvous: (re)write this host's marker file in
+        the shared dir and read back its mtime — the filesystem server's
+        clock, one reference every host observes — so hosts with no
+        common workload (disjoint serve replicas) still align.  None on
+        any filesystem error: rendezvous is opportunistic."""
+        try:
+            d = Path(self._rdv_dir)
+            d.mkdir(parents=True, exist_ok=True)
+            f = d / f"rdv-h{self.host}-{self.boot}"
+            f.write_text(json.dumps(
+                {"run": self.run_id, "host": self.host, "boot": self.boot}))
+            return float(f.stat().st_mtime)
+        except OSError:
+            return None
+
+    def rendezvous(self, shared_dir) -> Optional[float]:
+        """Explicitly rendezvous against ``shared_dir`` (a directory on a
+        filesystem all hosts mount) and emit a ref-bearing beacon.  The
+        env ``GRAFT_CLOCK_RDV`` arms the same thing on the periodic
+        beacon cadence."""
+        if self._fd is None:
+            return None
+        with self._lock:
+            prev = self._rdv_dir
+            self._rdv_dir = str(shared_dir)
+            try:
+                self._emit_beacon_locked()
+            finally:
+                self._rdv_dir = prev if prev is not None else str(shared_dir)
+        return None
+
+    # --- attach points ----------------------------------------------------
+
+    def attach_metrics(self, registry) -> None:
+        """Feed every emitted record to ``registry.observe_event`` (see
+        obs/metrics.py) — the emit path IS the metrics pipeline, so the
+        /metrics endpoint needs no second instrumentation pass."""
+        self._metrics = registry
+
+    def attach_alerts(self, engine) -> None:
+        """Run ``engine.observe`` (see obs/alerts.py) over every emitted
+        record; fired alerts are emitted back into this stream as
+        ``alert`` events (seq AFTER the cause record) and printed the way
+        note() prints."""
+        self._alerts = engine
+
+    def _fire_alerts_locked(self, fired) -> None:
+        for alert in fired or ():
+            msg = alert.get("msg") or alert.get("rule", "alert")
+            _print_note("[alert]", msg, "stderr")
+            self.event("alert", str(alert.get("rule", "?")), **alert)
 
     # --- rotation / lifecycle --------------------------------------------
 
@@ -322,6 +457,12 @@ def span(kind: str, name: str, **fields):
     return tel.span(kind, name, **fields)
 
 
+def _print_note(prefix: str, msg: str, stream: str) -> None:
+    """The operator-line half of note() — also what fired alerts print."""
+    out = sys.stdout if stream == "stdout" else sys.stderr
+    print(f"{prefix} {msg}", file=out, flush=True)
+
+
 def note(kind: str, name: str, msg: str, *, prefix: Optional[str] = None,
          stream: str = "stderr", **fields) -> None:
     """Operator message + telemetry event in one call — the OBS001
@@ -333,12 +474,22 @@ def note(kind: str, name: str, msg: str, *, prefix: Optional[str] = None,
     telemetry is active.  The print half is unconditional: the stream is
     *additional* observability, never a replacement for the line a human
     tails."""
-    out = sys.stdout if stream == "stdout" else sys.stderr
-    print(f"{prefix if prefix is not None else f'[{kind}]'} {msg}",
-          file=out, flush=True)
+    _print_note(prefix if prefix is not None else f"[{kind}]", msg, stream)
     tel = _active
     if tel is not None:
         tel.event(kind, name, msg=msg, **fields)
+
+
+def clock_beacon_payload() -> dict:
+    """The heartbeat-side clock payload: the active telemetry's beacon if
+    one is installed, else a fresh (wall, mono, boot) triple with the same
+    skew-injection semantics — so heartbeats carry alignment material even
+    on a run with telemetry off."""
+    tel = _active
+    if tel is not None:
+        return tel.clock_beacon()
+    return {"wall": time.time() + _env_clock_skew(),
+            "mono": time.monotonic(), "boot": _BOOT}
 
 
 # --- read side ------------------------------------------------------------
@@ -349,7 +500,15 @@ def _iter_stream_files(path: Path) -> List[Path]:
     ``events*.jsonl*`` members (rotated parts included), rotation-ordered
     so records come out in emission order per host."""
     if path.is_file():
-        return [path]
+        # an active-segment path brings its rotated siblings
+        # (<name>.1 .. <name>.N, oldest first) so merge/report see the
+        # full history, not just the live segment — a week-long run's
+        # events.jsonl is only the tail of its own story
+        rotated = sorted(
+            (int(p.name.rsplit(".", 1)[1]), p)
+            for p in path.parent.glob(path.name + ".*")
+            if p.name.rsplit(".", 1)[1].isdigit())
+        return [p for _, p in rotated] + [path]
 
     def order(p: Path):
         tail = p.name.rsplit(".", 1)[1]

@@ -69,9 +69,13 @@ def to_chrome_trace(events: List[dict]) -> dict:
         host = rec.get("host", 0)
         if host not in named_pids:
             named_pids[host] = str(rec.get("run", ""))
+            # aligned fleet merges relabel `host` to a unique lane index
+            # (align.align_lane) and keep the stream's own index in
+            # `orig_host` — name the pid lane with the original identity
             trace.append({"ph": "M", "name": "process_name", "pid": host,
                           "args": {"name": f"{rec.get('run', '')} "
-                                           f"(host {host})"}})
+                                           f"(host "
+                                           f"{rec.get('orig_host', host)})"}})
         tid = tid_for(host, str(rec.get("thread", "?")))
         name = f"{rec.get('kind', '?')}.{rec.get('name', '?')}"
         ts = float(rec.get("t", 0.0)) * 1e6

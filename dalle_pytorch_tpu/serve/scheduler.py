@@ -46,6 +46,7 @@ from typing import Deque, Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from ..obs import telemetry
 from ..utils import faults
 from .engine import SlotArena
@@ -159,7 +160,15 @@ class GenerationServer:
                      else np.asarray([self._seed, rid], np.uint32)),
                 submitted_at=self._time())
             self._queues[slo].append(handle)
+            depth = len(self._queues[slo])
         telemetry.emit("serve", "submit", rid=rid, slo=slo)
+        # queue depth is THE admission-feedback signal a front-end router
+        # consumes (per-replica load); direct-instrumented (not derived
+        # from events) so it works with telemetry off and never lags
+        reg = obs_metrics.active()
+        if reg is not None:
+            reg.gauge("graft_serve_queue_depth",
+                      "queued requests awaiting a slot", slo=slo).set(depth)
         return handle
 
     # --- scheduler iteration ----------------------------------------------
@@ -252,6 +261,18 @@ class GenerationServer:
                     preemptions=h.preemptions,
                     slo_ok=(None if target is None or h.latency is None
                             else bool(h.latency <= target)))
+                reg = obs_metrics.active()
+                if reg is not None and h.latency is not None:
+                    reg.histogram("graft_serve_latency_seconds",
+                                  "end-to-end request latency",
+                                  slo=h.slo).observe(h.latency)
+                    reg.counter("graft_serve_retired_total",
+                                "completed requests", slo=h.slo).inc()
+                    if target is not None:
+                        reg.counter(
+                            "graft_serve_slo_total",
+                            "retirements by SLO verdict", slo=h.slo,
+                            ok=str(bool(h.latency <= target)).lower()).inc()
                 h.future.set_result(codes)
 
     def _fail(self, slot: int, exc: BaseException) -> None:
@@ -316,6 +337,13 @@ class GenerationServer:
                        slo=handle.slo,
                        queue_wait_s=handle.admitted_at - handle.submitted_at,
                        preemptions=handle.preemptions)
+        reg = obs_metrics.active()
+        if reg is not None:
+            with self._lock:
+                depth = len(self._queues[handle.slo])
+            reg.gauge("graft_serve_queue_depth",
+                      "queued requests awaiting a slot",
+                      slo=handle.slo).set(depth)
         self._running[slot] = _Running(handle=handle, done=1)
         self._decoded_tokens += 1  # admit samples the request's first code
 
@@ -376,6 +404,14 @@ class GenerationServer:
                        active_min=agg["active_min"],
                        active_max=agg["active_max"],
                        clock_first=agg["clock_first"])
+        reg = obs_metrics.active()
+        if reg is not None:
+            reg.gauge("graft_serve_occupancy",
+                      "occupied-slot fraction over the last tick window"
+                      ).set(agg["active_sum"]
+                            / (agg["ticks"] * self.num_slots))
+            reg.counter("graft_serve_ticks_total", "decode ticks run"
+                        ).inc(agg["ticks"])
         self._tick_agg = {"ticks": 0, "active_sum": 0, "active_min": None,
                           "active_max": 0, "clock_first": None}
 
@@ -405,9 +441,13 @@ class GenerationServer:
                 return None
             return sum(v <= target for v in lat[slo]) / len(lat[slo])
 
+        with self._lock:
+            queue_depth = {slo: len(self._queues[slo])
+                           for slo in SLO_CLASSES}
         return dict(
             ticks=self._ticks,
             decoded_tokens=tokens,
+            queue_depth=queue_depth,
             tok_per_s=(tokens / window_seconds
                        if window_seconds else None),
             occupancy=(self._occupied_slot_ticks

@@ -237,6 +237,7 @@ class Heartbeat:
         a long-lived heartbeat dir fills with them.  On startup, remove
         temps older than a few beat intervals — anything that old cannot
         belong to a write still in flight."""
+        # graftlint: disable=OBS002 (cross-clock by design: the cutoff compares against file mtimes, which live on the wall clock)
         cutoff = time.time() - 3 * self.beat_interval
         for tmp in self.dir.glob(".hb-*"):
             try:
@@ -288,9 +289,15 @@ class Heartbeat:
                          **self._correlation(), "done": True})
 
     def _correlation(self) -> dict:
-        """run_id + telemetry last-seq fields for every heartbeat write."""
+        """run_id + telemetry last-seq + clock-beacon fields for every
+        heartbeat write.  The clock payload (wall<->mono offset pair +
+        boot nonce, obs/align.py's anchor material) rides here so a
+        monitor can place this host on the fleet timebase even when the
+        host died between telemetry rotations — and because the heartbeat
+        file lands on the monitor's filesystem, its mtime doubles as a
+        shared-clock rendezvous reference."""
         tel = telemetry.get()
-        out = {}
+        out = {"clock": telemetry.clock_beacon_payload()}
         run_id = self.run_id or (tel.run_id if tel is not None else None)
         if run_id is not None:
             out["run_id"] = run_id

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Regenerate the committed fleet-telemetry fixture (deterministic).
+
+Three per-host streams over ONE true timeline, each stamped through a
+deliberately broken wall clock — the shapes tests/test_obs_fleet.py pins
+the solver against:
+
+* ``host0`` — skew 0 (the honest host), 4 checkpoint publishes, 5
+  throughput-class serve retires.
+* ``host1`` — skew **+2.5 s**, steps consistently **80 ms late** in true
+  time (the straggler — lateness must survive alignment, skew must not),
+  5 latency-class retires (attainment 0.8), one TORN ckpt save span (B
+  without E: died mid-save), one pre-fired ``stall_fraction`` alert.
+* ``host2`` — skew **−0.8 s drifting +3 ms/s** of monotonic time, one
+  injected-fault event and one sample quarantine.
+
+Every stream carries ref-bearing ``clock.beacon`` records (the shared-
+file rendezvous shape: ``ref`` is the common filesystem clock at the
+beacon, here the true timeline itself), so the solver must recover each
+skew exactly; the drifting host needs the linear fit.
+
+Run from the repo root:  python tests/fixtures/obs/fleet/make_fleet.py
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+
+T0 = 1754300000.0          # true-timeline origin (wall seconds)
+STEPS = 20
+STEP_DT = 0.25             # true seconds per training step
+
+HOSTS = {
+    # name: (run id, skew0_s, drift_s_per_s, step_lateness_s, mono0)
+    "host0": ("fleet-h0", 0.0, 0.0, 0.0, 1000.0),
+    "host1": ("fleet-h1", 2.5, 0.0, 0.08, 2000.0),
+    "host2": ("fleet-h2", -0.8, 0.003, 0.0, 3000.0),
+}
+
+
+def main() -> None:
+    for name, (run, skew0, drift, late, mono0) in HOSTS.items():
+        seq = 0
+        recs = []
+
+        def mono_of(true_t: float) -> float:
+            return mono0 + (true_t - T0)
+
+        def skew_at(true_t: float) -> float:
+            return skew0 + drift * (mono_of(true_t) - mono0)
+
+        def rec(kind, nm, true_t, thread="MainThread", **fields):
+            nonlocal seq
+            seq += 1
+            r = dict(fields)
+            r.update(v=1, run=run, host=0, pid=4242, seq=seq,
+                     t=round(true_t + skew_at(true_t), 6),
+                     mono=round(mono_of(true_t), 6), thread=thread,
+                     kind=kind, name=nm)
+            recs.append(r)
+            return seq
+
+        def beacon(true_t):
+            rec("clock", "beacon", true_t,
+                wall=round(true_t + skew_at(true_t), 6),
+                mono=round(mono_of(true_t), 6),
+                boot=f"{name}-boot", ref=round(true_t, 6))
+
+        beacon(T0 + 0.01)
+        rec("run", "run_start", T0 + 0.02, step=0, trainer="train_fixture")
+        for s in range(1, STEPS + 1):
+            true_t = T0 + STEP_DT * s + late
+            rec("step", "train", true_t, step=s,
+                loss=round(2.0 / s, 4), step_time_s=STEP_DT, mfu=0.15,
+                loader_stall_frac=0.02)
+            if name == "host0" and s % 5 == 0:
+                b = rec("ckpt", "save", true_t + 0.01, ph="B", step=s,
+                        thread="ckpt-async-1")
+                rec("ckpt", "save", true_t + 0.05, ph="E", sid=b,
+                    dur_s=0.04, ok=True, thread="ckpt-async-1")
+                rec("ckpt", "publish", true_t + 0.06, step=s,
+                    thread="ckpt-async-1")
+            if name == "host2" and s % 5 == 0:
+                rec("ckpt", "publish", true_t + 0.04, step=s)
+        beacon(T0 + STEP_DT * 10)
+
+        if name == "host0":
+            for i in range(5):
+                true_t = T0 + 1.0 + i
+                rec("serve", "submit", true_t, rid=i, slo="throughput")
+                rec("serve", "retire", true_t + 0.5, rid=i, slot=i % 2,
+                    slo="throughput", tokens=16, latency_s=0.4 + 0.05 * i,
+                    queue_wait_s=0.02, slo_ok=(i != 4))
+        if name == "host1":
+            for i in range(5):
+                true_t = T0 + 1.0 + i
+                rec("serve", "submit", true_t, rid=i, slo="latency")
+                rec("serve", "retire", true_t + 1.1, rid=i, slot=0,
+                    slo="latency", tokens=16, latency_s=0.9 + 0.1 * i,
+                    queue_wait_s=0.05, slo_ok=(i != 3))
+            # died inside a save: B without E — the torn-span signature
+            rec("ckpt", "save", T0 + STEP_DT * 18, ph="B", step=18,
+                thread="ckpt-async-1")
+            rec("alert", "stall_fraction", T0 + STEP_DT * 19,
+                rule="stall_fraction", value=0.71, limit=0.5,
+                cause_seq=seq, msg="stall_fraction: window mean 0.71 > 0.5")
+        if name == "host2":
+            rec("fault", "shard_read", T0 + 2.6, action="truncate", step=9,
+                hits=1)
+            rec("data", "sample_quarantine", T0 + 2.7, key="s7")
+
+        rec("run", "run_end", T0 + STEP_DT * STEPS + 2.0 + late,
+            step=STEPS, completed=(name != "host1"))
+        beacon(T0 + STEP_DT * STEPS + 2.1)
+
+        out = HERE / name / "events.jsonl"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        with open(out, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r, separators=(",", ":"),
+                                   sort_keys=True) + "\n")
+        print(f"wrote {out} ({len(recs)} records)")
+
+
+if __name__ == "__main__":
+    main()

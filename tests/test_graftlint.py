@@ -372,6 +372,54 @@ def test_obs001_note_and_pragma_clean():
                 path="dalle_pytorch_tpu/utils/ckpt_manager.py") == []
 
 
+# --- OBS002 --------------------------------------------------------------
+
+
+def test_obs002_wall_clock_duration_math_flagged():
+    """Durations from wall-clock deltas skew across the fleet and step
+    under NTP — both the direct `time.time() - t0` form and a tracked
+    name assigned from time.time() are flagged inside the package."""
+    src = """
+    import time
+    def f():
+        t0 = time.time()
+        work()
+        return time.time() - t0
+    def g(deadline):
+        start = time.time()
+        return deadline - start
+    """
+    found = lint(src, select=("OBS002",),
+                 path="dalle_pytorch_tpu/serve/scheduler.py")
+    assert rules_of(found) == ["OBS002"] * 2
+
+
+def test_obs002_monotonic_and_out_of_scope_clean():
+    """time.monotonic()/perf_counter durations, bare timestamps, and code
+    outside dalle_pytorch_tpu/ (tools, trainers) stay clean."""
+    mono = """
+    import time
+    def f():
+        t0 = time.monotonic()
+        return time.monotonic() - t0
+    stamp = {"time": time.time()}
+    """
+    assert lint(mono, select=("OBS002",),
+                path="dalle_pytorch_tpu/utils/x.py") == []
+    wall = "import time\nd = time.time() - t0\n"
+    for path in ("tools/monitor.py", "train_dalle.py", "bench.py"):
+        assert lint_source(wall, select=("OBS002",), path=path) == [], path
+
+
+def test_obs002_pragma_with_reason_suppresses():
+    src = ("import time\n"
+           "age = time.time() - path.stat().st_mtime  "
+           "# graftlint: disable=OBS002 (cross-clock: mtimes live on the "
+           "wall clock)\n")
+    assert lint_source(src, select=("OBS002",),
+                       path="dalle_pytorch_tpu/utils/x.py") == []
+
+
 # --- engine machinery ----------------------------------------------------
 
 
@@ -811,7 +859,7 @@ def test_every_rule_has_fixture_coverage():
     """Meta: the rule registry and this file stay in sync — adding a rule
     without positive-fixture coverage fails here."""
     covered = {"ENV001", "SEED001", "BACKEND001", "DOT001", "TRACE001",
-               "EXC001", "CKPT001", "OBS001", "DON001", "DON002"}
+               "EXC001", "CKPT001", "OBS001", "OBS002", "DON001", "DON002"}
     assert covered == set(RULES)
 
 

@@ -65,22 +65,27 @@ def test_schema_roundtrip(tmp_path):
     telemetry.shutdown()
 
     recs = read_events(tmp_path)
-    assert len(recs) == 5
+    # 5 emitted records + the first-event clock beacon (PR 11: every
+    # stream periodically carries its wall<->mono offset pair)
+    assert len(recs) == 6
     for r in recs:
         jsonschema.validate(r, EVENT_SCHEMA)
-    assert [r["seq"] for r in recs] == [1, 2, 3, 4, 5]
+    assert [r["seq"] for r in recs] == [1, 2, 3, 4, 5, 6]
     assert all(r["run"] == "rt" and r["host"] == 0 for r in recs)
+    beacon = recs[1]
+    assert (beacon["kind"], beacon["name"]) == ("clock", "beacon")
+    assert beacon["wall"] > 0 and beacon["mono"] > 0 and beacon["boot"]
     step = recs[0]
     assert (step["kind"], step["name"], step["step"], step["loss"]) == \
         ("step", "train", 1, 2.5)
-    b, e = recs[1], recs[3]
+    b, e = recs[2], recs[4]
     assert b["ph"] == "B" and e["ph"] == "E"
     assert e["sid"] == b["seq"] and e["dur_s"] >= 0 and e["ok"] is True
-    assert recs[4]["msg"] == "step 9: spike"
+    assert recs[5]["msg"] == "step 9: spike"
 
 
 def test_envelope_wins_over_colliding_payload(tmp_path):
-    tel = telemetry.init(tmp_path, run_id="env")
+    tel = telemetry.init(tmp_path, run_id="env", beacon_every=0)
     tel.event("step", "train", seq=999, run="liar", note="kept")
     telemetry.shutdown()
     (rec,) = read_events(tmp_path)
@@ -96,12 +101,12 @@ def test_torn_trailing_line_skipped(tmp_path):
     with open(path, "ab") as f:  # the crash signature: a half-written line
         f.write(b'{"v":1,"run":"torn","host":0,"pid":1,"seq":3,"t":1.0,"mo')
     recs = read_events(path)
-    assert [r["step"] for r in recs] == [1, 2]
+    assert [r["step"] for r in recs if r["kind"] == "step"] == [1, 2]
 
 
 def test_non_host0_file_name_and_merge(tmp_path):
-    t0 = Telemetry(tmp_path, run_id="mh", host=0)
-    t1 = Telemetry(tmp_path, run_id="mh", host=1)
+    t0 = Telemetry(tmp_path, run_id="mh", host=0, beacon_every=0)
+    t1 = Telemetry(tmp_path, run_id="mh", host=1, beacon_every=0)
     t0.event("step", "train", step=1)
     t1.event("step", "train", step=1)
     t0.close()
@@ -117,7 +122,7 @@ def test_non_host0_file_name_and_merge(tmp_path):
 
 def test_rotation_bounds_and_merges(tmp_path):
     tel = telemetry.init(tmp_path, run_id="rot", rotate_bytes=2000,
-                         keep_rotated=2)
+                         keep_rotated=2, beacon_every=0)
     for i in range(200):
         tel.event("step", "train", step=i, filler="x" * 40)
     telemetry.shutdown()
@@ -199,7 +204,7 @@ def test_note_prints_and_emits(tmp_path, capsys):
     assert "[ckpt] save step 3 retrying" in out.err
     assert "warning: quarantining sample s1" in out.out
     telemetry.shutdown()
-    recs = read_events(tmp_path)
+    recs = [r for r in read_events(tmp_path) if r["kind"] != "clock"]
     assert [(r["kind"], r["name"]) for r in recs] == \
         [("ckpt", "save_retry"), ("data", "sample_quarantine")]
     assert recs[0]["msg"] == "save step 3 retrying"
@@ -259,7 +264,11 @@ def test_heartbeat_carries_run_id_and_telemetry_seq(tmp_path):
     hb.beat(2, epoch=0)
     info = json.loads((tmp_path / "hb" / "heartbeat-p0.json").read_text())
     assert info["run_id"] == "hb-run"
-    assert info["telemetry_seq"] == 2
+    assert info["telemetry_seq"] == 3  # 2 events + the first-event beacon
+    # the clock-beacon payload rides every beat (PR 11: monitor-side
+    # alignment material even when the host dies between rotations)
+    assert info["clock"]["wall"] > 0 and info["clock"]["mono"] > 0
+    assert info["clock"]["boot"] == tel.boot
     hb.close(done=True)
     info = json.loads((tmp_path / "hb" / "heartbeat-p0.json").read_text())
     assert info["done"] is True and info["run_id"] == "hb-run"
@@ -291,7 +300,7 @@ def test_monitor_prints_correlation_and_tail(tmp_path, capsys):
     assert monitor.main([str(tmp_path / "hb"), "--timeout", "1e-9",
                          "--telemetry-dir", str(tmp_path / "tel")]) == 1
     out = capsys.readouterr().out
-    assert "run mon-run" in out and "tel_seq 2" in out
+    assert "run mon-run" in out and "tel_seq 3" in out
     assert "last telemetry of process 0" in out
     assert "health.spike" in out
 

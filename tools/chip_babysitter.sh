@@ -175,6 +175,13 @@ if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
   BABYSIT_POLL=${BABYSIT_POLL:-60}
   BABYSIT_STEP_DEADLINE=${BABYSIT_STEP_DEADLINE:-0}
   BABYSIT_RELAUNCH_PLAN=${BABYSIT_RELAUNCH_PLAN:-}
+  # BABYSIT_METRICS_PORT > 0 wires --metrics_port into the supervised run
+  # (in-process /metrics + /healthz, obs/metrics.py) and the poll loop
+  # curls /healthz as a liveness probe ALONGSIDE the heartbeat scan — an
+  # endpoint that stops answering while the process is alive is an early
+  # wedge signal, logged here; the heartbeat scan stays the restart
+  # authority (the probe alone never kills)
+  BABYSIT_METRICS_PORT=${BABYSIT_METRICS_PORT:-0}
   # graftscope stream: the supervised run appends its events here, and on
   # every death/stall the victim's last events land in train_run.log via
   # obs_report --tail — a babysitter restart carries the previous run's
@@ -192,13 +199,22 @@ if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
         echo "$(date +%T) train supervisor: relaunching under --plan ${BABYSIT_RELAUNCH_PLAN} (elastic resume)"
       fi
       echo "$(date +%T) train supervisor: launch (restarts so far: $restarts/${BABYSIT_MAX_RESTARTS})"
+      metrics_args=""
+      if [ "${BABYSIT_METRICS_PORT}" -gt 0 ]; then
+        metrics_args="--metrics_port ${BABYSIT_METRICS_PORT}"
+      fi
       ${BABYSIT_TRAIN_CMD} --resume auto --heartbeat_dir "${BABYSIT_HB_DIR}" \
         --step_deadline "${BABYSIT_STEP_DEADLINE}" \
-        --telemetry_dir "${BABYSIT_TEL_DIR}" ${plan_args} \
+        --telemetry_dir "${BABYSIT_TEL_DIR}" ${plan_args} ${metrics_args} \
         >> "${CHIP_TMP}/train_run.log" 2>&1 &
       train_pid=$!
       while kill -0 "$train_pid" 2>/dev/null; do
         sleep "$BABYSIT_POLL"
+        if [ "${BABYSIT_METRICS_PORT}" -gt 0 ]; then
+          if ! curl -sf -m 5 "http://127.0.0.1:${BABYSIT_METRICS_PORT}/healthz" >/dev/null 2>&1; then
+            echo "$(date +%T) train supervisor: /healthz probe FAILED (pid alive; heartbeat scan decides the restart)"
+          fi
+        fi
         python tools/monitor.py "${BABYSIT_HB_DIR}" \
           --timeout "${BABYSIT_STALL_TIMEOUT}" \
           --telemetry-dir "${BABYSIT_TEL_DIR}" >/dev/null 2>&1

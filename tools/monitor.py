@@ -33,11 +33,22 @@ last event number); with ``--telemetry-dir`` a STALLED host's scan line is
 followed by its last few telemetry records — what the run was *doing*
 when it went quiet, not just that it did.
 
+**Fleet mode** (``--fleet DIR1 DIR2 ...``): tail N hosts' telemetry dirs
+instead of heartbeat files.  The scan aligns the streams onto one
+timebase (obs/align.py — heartbeats and beacons carry the clock payload,
+so a host that died between rotations still aligns), prints each lane's
+clock offset + residual bound, its last event age, the ``alert`` events
+already in its stream, and re-runs the declarative rules
+(obs/alerts.py) offline over the tail so a condition that built up right
+before a death still surfaces.  Exit 1 when any lane has active alerts
+or a stale stream, 2 when nothing is readable.
+
 Usage:
     python tools/monitor.py HEARTBEAT_DIR [--timeout 300] [--expect N] [--watch S]
     python tools/monitor.py hb --watch 60 --ckpt-dir checkpoints \
         --telemetry-dir tel \
         --restart-cmd 'nohup python train_dalle.py --resume auto ... &'
+    python tools/monitor.py --fleet telA telB --timeout 120
 
 Exit codes (the ``ExitCode`` taxonomy in utils/failure.py): 0 all hosts
 healthy, 1 stalled/missing hosts, 2 no heartbeats, 3 restart budget
@@ -171,9 +182,68 @@ def scan(directory: Path, timeout: float, expect: int | None,
     return int(ExitCode.MONITOR_STALLED) if bad else int(ExitCode.CLEAN)
 
 
+def fleet_scan(dirs: list[Path], timeout: float, window: float = 300.0
+               ) -> int:
+    """One fleet-mode scan over N telemetry dirs: align, tail, alert."""
+    import time as _time
+
+    from dalle_pytorch_tpu.obs import merge_streams
+    from dalle_pytorch_tpu.obs.alerts import AlertEngine
+
+    events, clocks = merge_streams(dirs)
+    if not events:
+        print(f"no readable events under {[str(d) for d in dirs]}",
+              file=sys.stderr)
+        return int(ExitCode.MONITOR_NO_HEARTBEATS)
+    now = _time.time()
+    by_lane: dict[int, list[dict]] = {}
+    for r in events:
+        by_lane.setdefault(int(r.get("host", 0)), []).append(r)
+    bad = 0
+    for clock in clocks:
+        lane = by_lane.get(clock.lane, [])
+        last = lane[-1] if lane else None
+        # ages compare FLEET time to this box's clock: the solved offset
+        # has already removed the host's skew, so "age" means what it says
+        age = (now - float(last["t"])) if last and last.get("t") else None
+        stale = age is not None and age > timeout
+        steps = [r for r in lane if r.get("kind") == "step"
+                 and "ph" not in r and r.get("step") is not None]
+        last_step = max((int(r["step"]) for r in steps), default=None)
+        # alerts already in the stream (the in-process engine fired) ...
+        recent_alerts = sorted({
+            str(r.get("name")) for r in lane if r.get("kind") == "alert"
+            and r.get("t") is not None and now - float(r["t"]) <= window})
+        # ... plus an offline re-run over the tail, so a condition that
+        # built up right before a death still surfaces here
+        engine = AlertEngine()
+        for r in lane:
+            for fired in engine.observe(r):
+                recent_alerts = sorted(set(recent_alerts)
+                                       | {fired["rule"]})
+        bound = clock.bound
+        status = "STALE" if stale else "ok"
+        print(f"lane {clock.lane} [{clock.run} host {clock.orig_host}]: "
+              f"{status} (last event "
+              f"{'-' if age is None else f'{age:.0f}s'} ago, step "
+              f"{last_step}, clock offset {clock.offset:+.3f}s "
+              f"±{'?' if bound is None else f'{bound:.3f}'} "
+              f"[{clock.method}])")
+        if recent_alerts:
+            print(f"  ALERTS: {', '.join(recent_alerts)}")
+        bad += stale or bool(recent_alerts)
+    return int(ExitCode.MONITOR_STALLED) if bad else int(ExitCode.CLEAN)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    parser.add_argument("heartbeat_dir", type=Path)
+    parser.add_argument("heartbeat_dir", type=Path, nargs="?", default=None)
+    parser.add_argument("--fleet", nargs="+", type=Path, default=None,
+                        metavar="TEL_DIR",
+                        help="fleet mode: scan N telemetry dirs (one per "
+                             "host) instead of heartbeat files — aligned "
+                             "clock offsets, last-event ages, active "
+                             "alerts per host")
     parser.add_argument("--timeout", type=float, default=300,
                         help="seconds without a beat before a host counts as "
                              "stalled (default 300)")
@@ -214,6 +284,19 @@ def main(argv=None) -> int:
                              "the report says WHAT it was doing, not just "
                              "that it stopped")
     args = parser.parse_args(argv)
+
+    if args.fleet:
+        code = int(ExitCode.MONITOR_NO_HEARTBEATS)
+        try:
+            while True:
+                code = fleet_scan(args.fleet, args.timeout)
+                if not args.watch:
+                    return code
+                time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return code
+    if args.heartbeat_dir is None:
+        parser.error("heartbeat_dir is required (or use --fleet)")
 
     def try_restart(restarts: int) -> int | None:
         """Run --restart-cmd once; returns an exit code to stop with, or

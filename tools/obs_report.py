@@ -20,6 +20,13 @@ trainers / serve scheduler via ``dalle_pytorch_tpu.obs`` and renders:
   bench-history.jsonl lines (bench.py's ``record_history`` emits the
   exact history payload as the event), so the committed perf history is
   derivable from a run's telemetry stream alone.
+* ``--merge DIR1 DIR2 …`` — the FLEET view: treat each path as one
+  host's stream, solve the cross-host clock model from its beacons /
+  matched step anchors (``obs/align.py``), rewrite every timestamp onto
+  one fleet timebase, and render the merged result — text/json get the
+  fleet report (per-lane offsets + residual bounds, global step
+  timeline, straggler ranking, merged serve SLO attainment), trace gets
+  one Perfetto document with one pid lane per host.
 
 Stdlib + the jax-free ``obs`` package only: this tool must run on a box
 whose accelerator tunnel is wedged — that is precisely when it is needed.
@@ -41,14 +48,21 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from dalle_pytorch_tpu.obs import (build_report, read_events,  # noqa: E402
+from dalle_pytorch_tpu.obs import (build_fleet_report,  # noqa: E402
+                                   build_report, merge_streams, read_events,
                                    render_text, to_chrome_trace)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    parser.add_argument("paths", nargs="+", type=Path,
+    parser.add_argument("paths", nargs="*", type=Path,
                         help="events.jsonl files or telemetry directories")
+    parser.add_argument("--merge", nargs="+", type=Path, default=None,
+                        metavar="DIR",
+                        help="fleet mode: one telemetry dir per host — "
+                             "align the streams onto one timebase "
+                             "(obs/align.py clock solver) and render the "
+                             "merged fleet report/trace")
     parser.add_argument("--format", choices=("text", "json", "trace"),
                         default="text")
     parser.add_argument("--output", type=Path, default=None,
@@ -62,11 +76,17 @@ def main(argv=None) -> int:
                              "envelope stripped) — the history file is "
                              "derivable from telemetry")
     args = parser.parse_args(argv)
+    if not args.paths and not args.merge:
+        parser.error("give stream paths, or --merge DIR1 DIR2 ...")
 
-    events = read_events(args.paths)
+    clocks = None
+    if args.merge:
+        events, clocks = merge_streams(args.merge + args.paths)
+    else:
+        events = read_events(args.paths)
     if not events:
-        print(f"no readable events under {[str(p) for p in args.paths]}",
-              file=sys.stderr)
+        srcs = [str(p) for p in (args.merge or []) + args.paths]
+        print(f"no readable events under {srcs}", file=sys.stderr)
         return 2
 
     if args.bench_jsonl:
@@ -92,9 +112,13 @@ def main(argv=None) -> int:
     elif args.format == "trace":
         out = json.dumps(to_chrome_trace(events), indent=1)
     elif args.format == "json":
-        out = json.dumps(build_report(events), indent=1, default=str)
+        rep = (build_fleet_report(events, clocks) if clocks is not None
+               else build_report(events))
+        out = json.dumps(rep, indent=1, default=str)
     else:
-        out = render_text(build_report(events))
+        rep = (build_fleet_report(events, clocks) if clocks is not None
+               else build_report(events))
+        out = render_text(rep)
 
     if args.output is not None:
         args.output.parent.mkdir(parents=True, exist_ok=True)

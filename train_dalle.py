@@ -104,6 +104,21 @@ def parse_args(argv=None):
                              'ckpt/health/fault/serve events, spans) here '
                              'for tools/obs_report.py; GRAFT_TELEMETRY=0 '
                              'hard-disables even when set')
+    parser.add_argument('--metrics_port', type=int, default=0,
+                        help='serve /metrics (Prometheus text) + /healthz '
+                             'from an in-process daemon thread on this '
+                             'port (+ process index, so multi-host runs '
+                             'on one box do not collide); series are fed '
+                             'by the telemetry emit path. 0 disables')
+    parser.add_argument('--alerts', action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help='attach the declarative alert engine (obs/'
+                             'alerts.py DEFAULT_RULES: stall fraction, '
+                             'MFU drop vs run median, quarantine rate, '
+                             'heartbeat gap) to the telemetry stream — '
+                             'fired alerts are emitted as `alert` events '
+                             'causally after their cause and printed to '
+                             'stderr. No-op without --telemetry_dir')
     parser.add_argument('--stall_timeout', type=float, default=0,
                         help='warn on stderr when no step completes for this '
                              'many seconds (0 disables the in-process '
@@ -813,10 +828,23 @@ def _main(argv, lr_scale=1.0, skip_past=None):
 
     # graftscope run telemetry (obs/): one events.jsonl per run — every
     # layer below (ckpt manager, guardrails, faults, loader, serve) emits
-    # into the installed singleton; disabled (a None get()) when no dir
+    # into the installed singleton; disabled (a None get()) when no dir.
+    # --metrics_port starts the /metrics + /healthz endpoint (fed by the
+    # emit path) and --alerts attaches the declarative rule engine, so
+    # fired alerts land in the SAME stream, causally after their cause.
+    metrics_server = None
+    if args.metrics_port:
+        from dalle_pytorch_tpu.obs import metrics as obs_metrics
+        metrics_server = obs_metrics.serve(
+            args.metrics_port + jax.process_index())
     if args.telemetry_dir:
-        obs.init(args.telemetry_dir, run_id=logger.run_name,
-                 host=jax.process_index())
+        tel = obs.init(args.telemetry_dir, run_id=logger.run_name,
+                       host=jax.process_index())
+        if metrics_server is not None:
+            tel.attach_metrics(metrics_server.registry)
+        if args.alerts:
+            from dalle_pytorch_tpu.obs.alerts import AlertEngine
+            tel.attach_alerts(AlertEngine())
         obs.emit('run', 'run_start', step=start_step, epoch=start_epoch,
                  config_fingerprint=config_fingerprint(dalle_cfg.to_dict()),
                  resumed_from=(str(args.dalle_path)
@@ -1217,6 +1245,8 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                  completed=completed, interrupted=interrupted,
                  **timer.percentiles())
         obs.shutdown()
+        if metrics_server is not None:
+            metrics_server.close()
 
     if not interrupted:
         final_path = save_model('./dalle-final.pt', EPOCHS)

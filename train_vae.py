@@ -73,6 +73,18 @@ def parse_args(argv=None):
                              'health/fault events, spans) here for '
                              'tools/obs_report.py; GRAFT_TELEMETRY=0 '
                              'hard-disables even when set')
+    parser.add_argument('--metrics_port', type=int, default=0,
+                        help='serve /metrics (Prometheus text) + /healthz '
+                             'from an in-process daemon thread on this '
+                             'port (+ process index); series are fed by '
+                             'the telemetry emit path. 0 disables')
+    parser.add_argument('--alerts', action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help='attach the declarative alert engine (obs/'
+                             'alerts.py DEFAULT_RULES) to the telemetry '
+                             'stream; fired alerts are emitted as `alert` '
+                             'events causally after their cause and '
+                             'printed. No-op without --telemetry_dir')
     parser.add_argument('--stall_timeout', type=float, default=0,
                         help='warn on stderr when no step completes for this '
                              'many seconds (0 disables the in-process '
@@ -419,10 +431,22 @@ def _main(argv, lr_scale=1.0, skip_past=None):
 
     # graftscope run telemetry: one events.jsonl per run — the layers
     # below (ckpt manager, guardrails, faults, loader) emit into the
-    # installed singleton
+    # installed singleton.  --metrics_port starts /metrics + /healthz
+    # (fed by the emit path); --alerts attaches the declarative rule
+    # engine so fired alerts land in the same stream after their cause.
+    metrics_server = None
+    if args.metrics_port:
+        from dalle_pytorch_tpu.obs import metrics as obs_metrics
+        metrics_server = obs_metrics.serve(
+            args.metrics_port + jax.process_index())
     if args.telemetry_dir:
-        obs.init(args.telemetry_dir, run_id=logger.run_name,
-                 host=jax.process_index())
+        tel = obs.init(args.telemetry_dir, run_id=logger.run_name,
+                       host=jax.process_index())
+        if metrics_server is not None:
+            tel.attach_metrics(metrics_server.registry)
+        if args.alerts:
+            from dalle_pytorch_tpu.obs.alerts import AlertEngine
+            tel.attach_alerts(AlertEngine())
         obs.emit('run', 'run_start',
                  step=(int(resume_ckpt.get('global_step', 0))
                        if resume_ckpt is not None else 0),
@@ -714,6 +738,8 @@ def _main(argv, lr_scale=1.0, skip_past=None):
         obs.emit('run', 'run_end', step=global_step, completed=completed,
                  interrupted=interrupted, **timer.percentiles())
         obs.shutdown()
+        if metrics_server is not None:
+            metrics_server.close()
 
     if not interrupted:
         final_path = save_vae_model('vae-final.pt', EPOCHS)
