@@ -441,6 +441,44 @@ def rule_obs002(ctx: FileCtx) -> Iterator[RuleHit]:
                 yield node, msg
 
 
+# --- SRV001: unbounded blocking waits in serve/ ---------------------------
+
+_SRV_BLOCKING = frozenset(("result", "get", "acquire"))
+
+
+def rule_srv001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """A blocking wait without a timeout inside ``dalle_pytorch_tpu/serve/``
+    turns a dead replica into a hung router: the whole fleet tier exists
+    to convert losses into typed errors, and one ``future.result()`` with
+    no deadline quietly reintroduces the infinite hang the SLO layer can
+    never shed.  Flags ``.result()`` / ``.get()`` / ``.acquire()`` calls
+    that pass neither a positional argument nor a ``timeout=`` keyword
+    (a zero-arg ``.get()`` is the blocking queue form — dict ``.get``
+    always takes a key).  ``with lock:`` blocks are fine (bounded by the
+    holder, not a wait-for-event); pragma with why a wait is provably
+    bounded where the rule over-approximates."""
+    msg = ("blocking {}() without a timeout in serve/: a dead replica or a "
+           "lost wakeup turns this wait into a hang no SLO policy can "
+           "shed; pass an explicit timeout (and handle expiry) or pragma "
+           "with why this wait is bounded")
+    parts = tuple(ctx.path.replace("\\", "/").split("/"))
+    if "dalle_pytorch_tpu" not in parts:
+        return
+    sub = parts[parts.index("dalle_pytorch_tpu") + 1:]
+    if not sub or sub[0] != "serve":
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in _SRV_BLOCKING:
+            continue
+        if node.args:
+            continue  # positional timeout (result(t), get(block, t))
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        yield node, msg.format(node.func.attr)
+
+
 # --- DON001/DON002: buffer donation (the AST side of graftspmd S2) --------
 
 _STEP_FACTORY_RE = re.compile(r"^make_\w*step\w*$")
@@ -686,6 +724,7 @@ RULES = {
     "CKPT001": rule_ckpt001,
     "OBS001": rule_obs001,
     "OBS002": rule_obs002,
+    "SRV001": rule_srv001,
     "DON001": rule_don001,
     "DON002": rule_don002,
 }

@@ -1,4 +1,4 @@
-"""Continuous-batching generation service (DESIGN.md §11).
+"""Continuous-batching generation service (DESIGN.md §11, fleet tier §17).
 
 ``engine.SlotArena`` is the device half: a fixed-shape slot-structured KV
 arena where admission/retirement are ``dynamic_update_slice``s and one
@@ -9,12 +9,28 @@ request queue, iteration-level admission, SLO-aware scheduling
 (latency-class requests preempt throughput-class fills), and the
 per-request latency / aggregate throughput accounting ``bench_serve``
 reports.
+
+The fleet tier sits on top: ``replica.Replica`` wraps one server with a
+JOINING→SERVING→DRAINING→DEAD lifecycle + driver thread, and
+``router.FleetRouter`` routes over N replicas — consistent-hash
+affinity with queue-depth spill, SLO-aware shedding (typed
+``ShedError``), bounded retries with exponential backoff, drain/join
+riding the rc-74 preemption contract, and an exactly-once future
+resolution audit (zero dropped futures under replica loss).
 """
 from .engine import ArenaGeometry, SlotArena
+from .replica import (DEAD, DRAINING, JOINING, SERVING, Replica,
+                      ReplicaDown)
+from .router import (FleetRouter, NoHealthyReplica, RequestFailed,
+                     RetriesExhausted, RouterError, RouterHandle,
+                     ShedError)
 from .scheduler import (LATENCY, SLO_CLASSES, THROUGHPUT, GenerationServer,
-                        ServeHandle)
+                        ServeHandle, ServerStopped)
 
 __all__ = [
     "ArenaGeometry", "SlotArena", "GenerationServer", "ServeHandle",
-    "LATENCY", "THROUGHPUT", "SLO_CLASSES",
+    "ServerStopped", "LATENCY", "THROUGHPUT", "SLO_CLASSES",
+    "Replica", "ReplicaDown", "JOINING", "SERVING", "DRAINING", "DEAD",
+    "FleetRouter", "RouterHandle", "RouterError", "ShedError",
+    "RetriesExhausted", "RequestFailed", "NoHealthyReplica",
 ]

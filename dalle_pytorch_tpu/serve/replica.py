@@ -1,0 +1,258 @@
+"""Replica: one GenerationServer under a lifecycle state machine.
+
+The fleet tier's unit of failure (DESIGN.md §17).  A single
+``GenerationServer`` is one arena on one chip: when it dies, every
+request it holds dies with it.  ``Replica`` wraps a server with the
+state machine a router can reason about::
+
+    JOINING ──warm──▶ SERVING ──drain──▶ DRAINING ──▶ DEAD
+                         │                              ▲
+                         └────────── died ──────────────┘
+
+* **JOINING** — the driver thread is compiling the serve entry points
+  (prefill/admit/tick) against a warmup prompt.  A joining replica takes
+  no traffic: compiling on the first real request would hold that
+  request (and the router's retry clock) for the whole compile.
+* **SERVING** — the driver loop runs ``server.step()`` continuously,
+  stamping a heartbeat (``last_beat``) every iteration.  The
+  ``replica_down`` faultpoint fires here once per pass with ``step`` =
+  the completed decode-tick count:
+  ``replica_down:at_tick=N`` makes the thread *vanish* mid-decode — no
+  cleanup, no future resolution — so the router's failure detectors
+  (heartbeat staleness, :meth:`Replica.healthz`) are what find the
+  corpse, exactly like a killed pod.
+* **DRAINING** — the rc-74 preemption drill's shape applied to serving
+  (utils/faults.py ``preempt``): the replica stops admitting
+  (:meth:`begin_drain` evicts the queued backlog with a typed error the
+  router resubmits elsewhere) and its running slots get the drain grace
+  window to finish; :meth:`finish_drain` closes a clean drain,
+  :meth:`halt` is the grace-expired hard kill that fails-and-migrates
+  whatever is still running.  Either way nothing hangs.
+* **DEAD** — terminal.  A rolled replica is replaced by a *new*
+  ``Replica`` joining under traffic, never resurrected.
+
+Every transition emits a ``replica.state`` graftscope event and updates
+the one-hot ``graft_replica_state{replica,state}`` gauges, so
+``obs_report --merge`` and ``monitor --fleet --metrics`` both see the
+fleet's lifecycle.  When ``telemetry_dir`` is given the replica owns its
+OWN ``Telemetry`` stream (one lane per replica in the merged fleet
+report); its server emits serve events (submit/admit/tick/retire) into
+the same lane.
+
+Thread model: exactly one driver thread per replica (spawned by
+:meth:`start`); the router calls ``server.submit`` from its own threads
+(thread-safe) and the lifecycle methods from its monitor thread.
+``halt``/``finish_drain`` join the driver before touching the server's
+slot bookkeeping — ``GenerationServer.stop`` must not race a live
+``step()``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..obs import metrics as obs_metrics
+from ..obs import telemetry
+from ..obs.telemetry import Telemetry
+from ..utils import faults
+from .scheduler import GenerationServer, ServerStopped
+
+JOINING = "joining"
+SERVING = "serving"
+DRAINING = "draining"
+DEAD = "dead"
+STATES = (JOINING, SERVING, DRAINING, DEAD)
+
+
+class ReplicaDown(ServerStopped):
+    """Typed: the replica serving this request died, was halted, or was
+    drained before the request finished — the router's retry path
+    resubmits it elsewhere (the request replays deterministically from
+    prefill: its key stream is pinned at submission)."""
+
+
+class Replica:
+    """One ``GenerationServer`` + driver thread + lifecycle state."""
+
+    def __init__(self, name: str, dalle, variables, num_slots: int = 4, *,
+                 telemetry_dir=None, host_index: int = 0,
+                 warmup_text=None, idle_sleep_s: float = 0.001,
+                 time_fn=time.monotonic, **server_kwargs):
+        self.name = str(name)
+        self._time = time_fn
+        self._tel: Optional[Telemetry] = (
+            Telemetry(telemetry_dir, host=host_index)
+            if telemetry_dir is not None else None)
+        self.server = GenerationServer(
+            dalle, variables, num_slots, tel=self._tel,
+            metrics_labels={"replica": self.name}, **server_kwargs)
+        self.num_slots = int(num_slots)
+        self.warmup_text = warmup_text
+        self.idle_sleep_s = float(idle_sleep_s)
+        self._state = JOINING
+        self._state_lock = threading.Lock()
+        self.last_beat = self._time()
+        self.ticks = 0        # driver loop passes (the heartbeat cadence)
+        self.work_ticks = 0   # decode ticks that advanced a slot
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._announce(None, JOINING, "created")
+
+    # --- state machine -----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._state_lock:
+            return self._state
+
+    def _to(self, new: str, *, reason: str = "") -> None:
+        with self._state_lock:
+            old, self._state = self._state, new
+        if old != new:
+            self._announce(old, new, reason)
+
+    def _announce(self, old: Optional[str], new: str, reason: str) -> None:
+        self._emit("replica", "state", replica=self.name, frm=old, to=new,
+                   reason=reason)
+        reg = obs_metrics.active()
+        if reg is not None:
+            # one-hot across the state labels: a scraper reads the current
+            # state as "the label whose gauge is 1" without diffing
+            for s in STATES:
+                reg.gauge("graft_replica_state",
+                          "replica lifecycle state (one-hot per state)",
+                          replica=self.name, state=s
+                          ).set(1.0 if s == new else 0.0)
+
+    def _emit(self, kind: str, name: str, **fields):
+        if self._tel is not None:
+            return self._tel.event(kind, name, **fields)
+        return telemetry.emit(kind, name, **fields)
+
+    # --- driver thread -----------------------------------------------------
+
+    def start(self) -> "Replica":
+        """Spawn the driver thread (JOINING → warm → SERVING)."""
+        assert self._thread is None, f"replica {self.name} already started"
+        self._thread = threading.Thread(
+            target=self._run, name=f"replica-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def alive(self) -> bool:
+        """True while the driver thread is running.  A replica whose
+        thread died (kill, crash, injected ``replica_down``) reads False
+        here even though its ``state`` may still say SERVING — the state
+        is a claim, liveness is a fact, and the router trusts the fact."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def beat_age(self) -> float:
+        """Seconds since the driver loop last stamped its heartbeat."""
+        return self._time() - self.last_beat
+
+    def _warm(self) -> None:
+        """Compile the serve entry points before taking traffic: one
+        warmup request driven to completion (its result is discarded)."""
+        if self.warmup_text is None:
+            return
+        h = self.server.submit(self.warmup_text)
+        bound = 8 * self.server.arena.geometry.image_seq_len + 64
+        steps = 0
+        while not h.future.done() and not self._stop_evt.is_set():
+            self.server.step()
+            steps += 1
+            assert steps < bound, "warmup request did not converge"
+
+    def _run(self) -> None:
+        try:
+            self._warm()
+            if self._stop_evt.is_set():
+                return
+            if self.state == JOINING:  # a drain can race the warmup
+                self._to(SERVING, reason="warm")
+            while not self._stop_evt.is_set():
+                self.last_beat = self._time()
+                self.ticks += 1
+                # step coordinate = completed DECODE ticks, not loop
+                # passes: an idle loop spins orders of magnitude faster
+                # than it decodes, so `at_tick=N` pinned to loop passes
+                # would fire before traffic ever arrived — the chaos spec
+                # means "after the Nth decode tick", i.e. mid-stream
+                if "at_tick" in faults.fire("replica_down",
+                                            step=self.work_ticks):
+                    # abrupt death: the thread vanishes mid-decode without
+                    # failing its futures — detection is the ROUTER's job
+                    # (heartbeat staleness / healthz), like a killed pod
+                    return
+                advanced = self.server.step()
+                if advanced:
+                    self.work_ticks += 1
+                elif not self.server.busy:
+                    if self._stop_evt.wait(self.idle_sleep_s):
+                        break
+        # graftlint: disable=EXC001 (driver thread of record: its death must land in the stream as an event; the router re-detects it via heartbeat staleness and migrates the futures)
+        except BaseException as e:
+            self._emit("replica", "driver_error", replica=self.name,
+                       tick=self.ticks, error=repr(e))
+
+    # --- probes ------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        """The active-probe surface (in-process analog of GET /healthz).
+        The ``replica_health`` faultpoint makes probe failures injectable
+        while the driver keeps beating — the probe-without-heartbeat
+        signal the router treats as a graceful quarantine, not a death."""
+        try:
+            faults.fire("replica_health")
+        except faults.InjectedFault as e:
+            return {"ok": False, "replica": self.name, "error": repr(e)}
+        state = self.state
+        return {"ok": self.alive() and state in (JOINING, SERVING, DRAINING),
+                "replica": self.name, "state": state,
+                "beat_age_s": round(self.beat_age(), 3),
+                "ticks": self.ticks, "work_ticks": self.work_ticks,
+                **self.server.backlog()}
+
+    # --- drain / halt ------------------------------------------------------
+
+    def begin_drain(self, *, reason: str = "drain"):
+        """Stop admitting and evict the queued backlog, each failed with
+        :class:`ReplicaDown` (the router resubmits them elsewhere).
+        Running slots keep decoding toward the grace deadline the router
+        accounts.  Returns the evicted handles."""
+        self._to(DRAINING, reason=reason)
+        return self.server.evict_queued(ReplicaDown(
+            f"replica {self.name} draining ({reason}): request migrated"))
+
+    def finish_drain(self, *, join_timeout_s: float = 5.0):
+        """Clean drain completion: the running slots finished inside the
+        grace window.  Stops the driver and goes DEAD with nothing left
+        in flight (returns [] on a truly clean drain)."""
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout_s)
+        left = self.server.stop(ReplicaDown(
+            f"replica {self.name}: stopped at drain completion"))
+        self._to(DEAD, reason="drained")
+        return left
+
+    def halt(self, error: Optional[BaseException] = None, *,
+             join_timeout_s: float = 5.0):
+        """Hard stop: the grace window expired, or the router declared
+        this replica dead.  Stops the driver (if it still runs), fails
+        every in-flight future with a typed error so the router migrates
+        them, and goes DEAD.  Returns the unfinished handles."""
+        self._stop_evt.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=join_timeout_s)
+        unfinished = self.server.stop(
+            error if error is not None
+            else ReplicaDown(f"replica {self.name} halted"))
+        self._to(DEAD, reason="halt")
+        return unfinished
+
+    def close(self) -> None:
+        """Release the replica's own telemetry stream (if any)."""
+        if self._tel is not None:
+            self._tel.close()

@@ -1,0 +1,635 @@
+"""FleetRouter: replica loss is a retry, not an outage.
+
+The front end of the fleet tier (DESIGN.md §17): N :class:`Replica`
+instances behind one ``submit()``, built so that **every future resolves
+exactly once** — with decoded codes, a :class:`ShedError`, or a typed
+:class:`RouterError` — whatever dies underneath it.  The pieces:
+
+* **Routing** — consistent hash on the prompt bytes (crc32 ring,
+  ``virtual_nodes`` points per replica) so a repeated prompt lands on the
+  same replica (cache affinity: the prefix-reuse levers under ROADMAP
+  direction 3 only pay off if repeats co-locate), with **queue-depth
+  spill**: when the affine replica's queued backlog exceeds
+  ``spill_depth`` (the PR 11 feedback signal,
+  ``GenerationServer.backlog()``), the request goes to the least-loaded
+  SERVING replica instead — affinity is a preference, load is a bound.
+* **SLO-aware shedding** — admission compares each class's fleet-wide
+  queued backlog against its bound (``shed_bounds``, default
+  1×fleet-slots for ``latency``, 4× for ``throughput``: a latency-class
+  request that would queue deep will miss its target anyway, so the
+  honest answer is an immediate typed refusal the caller can retry
+  against).  A shed future resolves with :class:`ShedError` at submit
+  time — never a hang.
+* **Retries** — a request on a failed or draining replica is resubmitted
+  from prefill with exponential backoff, bounded by ``max_retries``;
+  the per-request key is pinned at first submission, so a retried
+  request replays the exact token stream the single-server path would
+  have produced (the chaos gate's bit-match).  Futures are deduplicated
+  by router request id: a late completion from a replica presumed dead
+  is dropped, the caller's future resolves exactly once.
+* **Failure detection** — three signals, three policies:
+
+  1. *future exception* (request-scoped): a replica-side future carrying
+     :class:`ServerStopped`/``InjectedFault`` is transient — retry with
+     backoff; anything else is terminal for that request
+     (:class:`RequestFailed`).  One bad request never condemns a replica.
+  2. *heartbeat staleness* (passive, replica-scoped): a SERVING replica
+     whose driver thread died or stopped beating for
+     ``heartbeat_timeout_s`` is declared DEAD immediately — its in-flight
+     futures are failed typed (``Replica.halt``) and resubmitted.
+  3. */healthz* probe (active, replica-scoped): ``probe_failures``
+     consecutive failed probes start a graceful DRAIN — stop routing
+     there, let running slots finish — because a sick-but-beating
+     replica deserves a drain, not a massacre.
+
+* **Drain/join** — :meth:`drain` rides the rc-74 preemption-drill shape:
+  the replica stops admitting, its queued backlog migrates immediately,
+  and its running slots get ``drain_grace_s`` to finish before
+  :meth:`poll` hard-halts and migrates them too.  :meth:`join` adds a
+  replica under traffic: it warms (JOINING) and self-promotes to
+  SERVING, at which point the hash ring includes it.
+
+Every decision emits a ``router.*`` graftscope event and bumps
+``graft_router_*`` instruments, so ``obs_report --merge`` over the
+router + per-replica streams renders the fleet request flow and
+``monitor --fleet --metrics`` scrapes the live state.
+
+The monitor loop runs on a daemon thread (:meth:`start`); every pass is
+one :meth:`poll` call, which tests drive directly for determinism.
+"""
+from __future__ import annotations
+
+import bisect
+import concurrent.futures
+import dataclasses
+import heapq
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import telemetry
+from ..utils import faults
+from .replica import DEAD, DRAINING, SERVING, Replica, ReplicaDown
+from .scheduler import LATENCY, SLO_CLASSES, THROUGHPUT, ServerStopped
+
+
+class RouterError(RuntimeError):
+    """Base of every terminal error a router future can carry.  The
+    exactly-once contract: a future from :meth:`FleetRouter.submit`
+    resolves with codes, a :class:`ShedError`, or a RouterError — never
+    hangs, never resolves twice."""
+
+
+class ShedError(RouterError):
+    """Admission refused NOW (SLO-aware load shedding): this class's
+    fleet-wide backlog exceeds its bound, so queueing would only
+    manufacture an SLO miss.  Immediate and typed — the caller retries
+    against it (or downgrades class); it never waits."""
+
+    def __init__(self, msg: str, *, slo: Optional[str] = None,
+                 depth: Optional[int] = None, bound: Optional[int] = None):
+        super().__init__(msg)
+        self.slo = slo
+        self.depth = depth
+        self.bound = bound
+
+
+class RetriesExhausted(RouterError):
+    """The bounded retry budget ran out; ``__cause__`` is the last
+    per-attempt failure."""
+
+
+class RequestFailed(RouterError):
+    """A replica failed this request with a non-transient error;
+    ``__cause__`` carries it.  Not retried: a deterministic failure
+    replays identically on every replica."""
+
+
+class NoHealthyReplica(RouterError):
+    """No SERVING replica at dispatch time (transient inside the retry
+    path: a rolling restart's empty window; terminal only when it
+    exhausts the retry budget)."""
+
+
+@dataclasses.dataclass
+class RouterHandle:
+    """One routed request: the caller-facing future + audit trail."""
+
+    request_id: int
+    slo: str
+    future: concurrent.futures.Future
+    submitted_at: float = 0.0
+    # (replica, attempt) per dispatch — the migration story of this
+    # request, readable after the fact (tests pin affinity/spill on it)
+    trail: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+
+    def result(self, timeout: Optional[float] = None):
+        """Decoded codes [image_seq_len]; raises the typed terminal error
+        otherwise.  Resolves exactly once — see :class:`RouterError`."""
+        return self.future.result(timeout)
+
+
+@dataclasses.dataclass
+class _Tracked:
+    """Router-side state of one in-flight request."""
+
+    handle: RouterHandle
+    text: np.ndarray
+    slo: str
+    temperature: float
+    key: np.ndarray            # pinned at submit: retries replay it
+    attempts: int = 0          # dispatches so far
+    replica: Optional[str] = None
+    resolved: bool = False
+
+
+# default shed bounds as multiples of the serving fleet's slot count
+_SHED_FACTORS = {LATENCY: 1.0, THROUGHPUT: 4.0}
+
+
+class FleetRouter:
+    """Front end over N in-process :class:`Replica` instances (the
+    chip-free tier; each replica is one arena + driver thread)."""
+
+    def __init__(self, replicas=(), *, seed: int = 0,
+                 virtual_nodes: int = 32, spill_depth: int = 4,
+                 shed_bounds: Optional[Dict[str, int]] = None,
+                 max_retries: int = 3, retry_backoff_s: float = 0.05,
+                 retry_backoff_cap_s: float = 1.0,
+                 heartbeat_timeout_s: float = 5.0,
+                 probe_every_s: float = 0.25, probe_failures: int = 3,
+                 drain_grace_s: float = 10.0,
+                 monitor_interval_s: float = 0.02,
+                 time_fn=time.monotonic):
+        self._time = time_fn
+        self._seed = int(seed)
+        self.virtual_nodes = int(virtual_nodes)
+        self.spill_depth = int(spill_depth)
+        self.shed_bounds = dict(shed_bounds) if shed_bounds else None
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_backoff_cap_s = float(retry_backoff_cap_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.probe_every_s = float(probe_every_s)
+        self.probe_failures = int(probe_failures)
+        self.drain_grace_s = float(drain_grace_s)
+        self.monitor_interval_s = float(monitor_interval_s)
+
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, Replica] = {}
+        self._tracked: Dict[int, _Tracked] = {}
+        self._retries: List[Tuple[float, int]] = []   # heap of (due, rid)
+        self._drains: Dict[str, float] = {}           # name -> grace deadline
+        self._probe_fail: Dict[str, int] = {}
+        self._last_probe = float("-inf")
+        self._next_rid = 0
+        self._closing = False
+        self._stop_evt = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+        # audit counters (the zero-dropped-futures ledger)
+        self.resolved_ok = 0
+        self.resolved_err = 0
+        self.retries_total = 0
+        self.replica_deaths = 0
+        self.shed = {slo: 0 for slo in SLO_CLASSES}
+
+        for r in replicas:
+            self.add_replica(r, start=False)
+
+    # --- membership --------------------------------------------------------
+
+    def add_replica(self, replica: Replica, *, start: bool = True
+                    ) -> Replica:
+        """Register (and by default start) a replica.  It takes traffic
+        only once its own driver promotes it to SERVING."""
+        with self._lock:
+            assert replica.name not in self._replicas, replica.name
+            self._replicas[replica.name] = replica
+            self._probe_fail[replica.name] = 0
+        self._emit("router", "replica_join", replica=replica.name)
+        if start and replica._thread is None:
+            replica.start()
+        return replica
+
+    def join(self, replica: Replica) -> Replica:
+        """Add a replica under traffic (alias of :meth:`add_replica` with
+        start=True — the rolling-restart read)."""
+        return self.add_replica(replica, start=True)
+
+    def replica(self, name: str) -> Replica:
+        with self._lock:
+            return self._replicas[name]
+
+    def _serving(self) -> List[Replica]:
+        with self._lock:
+            reps = list(self._replicas.values())
+        return [r for r in reps if r.state == SERVING and r.alive()]
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        """Start every not-yet-started replica and the monitor thread."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        for r in reps:
+            if r._thread is None:
+                r.start()
+        if self._monitor is None:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="fleet-router-monitor",
+                daemon=True)
+            self._monitor.start()
+        return self
+
+    def wait_serving(self, n: int = 1, timeout_s: float = 30.0) -> None:
+        """Block until ``n`` replicas are SERVING (warm) or raise."""
+        deadline = self._time() + timeout_s
+        while len(self._serving()) < n:
+            if self._time() > deadline:
+                raise RuntimeError(
+                    f"{len(self._serving())}/{n} replicas serving after "
+                    f"{timeout_s}s")
+            time.sleep(0.005)
+
+    def close(self) -> None:
+        """Stop monitoring, halt every live replica, and fail any still
+        unresolved future with a typed RouterError — closing the router
+        upholds the never-hang contract too."""
+        self._closing = True
+        self._stop_evt.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        with self._lock:
+            reps = list(self._replicas.values())
+        for r in reps:
+            if r.state != DEAD:
+                r.halt(ReplicaDown(f"replica {r.name}: router closed"))
+        with self._lock:
+            leftovers = list(self._tracked.values())
+        for t in leftovers:
+            err = RouterError("router closed with the request unresolved")
+            self._reject(t, err)
+        for r in reps:
+            r.close()
+
+    # --- submission --------------------------------------------------------
+
+    def submit(self, text, *, slo: str = THROUGHPUT,
+               temperature: float = 1.0, key=None) -> RouterHandle:
+        """Route one request into the fleet (thread-safe).  The returned
+        handle's future resolves EXACTLY ONCE: decoded codes, a
+        :class:`ShedError` (immediate, at submit), or a
+        :class:`RouterError` after the retry budget — never a hang."""
+        if slo not in SLO_CLASSES:
+            raise ValueError(f"unknown SLO class {slo!r}; one of "
+                             f"{SLO_CLASSES}")
+        text = np.asarray(text, np.int32)
+        if text.ndim == 1:
+            text = text[None]
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        handle = RouterHandle(request_id=rid, slo=slo,
+                              future=concurrent.futures.Future(),
+                              submitted_at=self._time())
+        tracked = _Tracked(
+            handle=handle, text=text, slo=slo,
+            temperature=float(temperature),
+            # the key is pinned HERE so every retry replays the same
+            # stream — the bit-match-after-migration invariant
+            key=(np.asarray(key, np.uint32) if key is not None
+                 else np.asarray([self._seed, rid], np.uint32)))
+        self._emit("router", "submit", rid=rid, slo=slo)
+        reg = obs_metrics.active()
+        if reg is not None:
+            reg.counter("graft_router_submitted_total",
+                        "requests entering the router", slo=slo).inc()
+        bound, depth = self._shed_check(slo)
+        if bound is not None and depth >= bound:
+            err = ShedError(
+                f"shed: {slo} fleet backlog {depth} >= bound {bound}",
+                slo=slo, depth=depth, bound=bound)
+            self.shed[slo] += 1
+            self._emit("router", "shed", rid=rid, slo=slo, depth=depth,
+                       bound=bound)
+            if reg is not None:
+                reg.counter("graft_router_shed_total",
+                            "requests shed at admission", slo=slo).inc()
+            handle.future.set_exception(err)
+            return handle
+        with self._lock:
+            self._tracked[rid] = tracked
+        self._set_inflight_gauge()
+        self._dispatch(tracked)
+        return handle
+
+    def _shed_check(self, slo: str) -> Tuple[Optional[int], int]:
+        """(bound, current fleet-wide queued depth) for one SLO class;
+        bound None when there is no serving capacity to measure against
+        (admission then rides the bounded retry path instead)."""
+        reps = self._serving()
+        if not reps:
+            return None, 0
+        depth = sum(r.server.backlog()["queued"][slo] for r in reps)
+        bound = (self.shed_bounds or {}).get(slo)
+        if bound is None:
+            slots = sum(r.num_slots for r in reps)
+            bound = max(1, int(_SHED_FACTORS[slo] * slots))
+        return bound, depth
+
+    # --- routing -----------------------------------------------------------
+
+    def _ring_for(self, reps: List[Replica]) -> List[Tuple[int, str]]:
+        ring = []
+        for r in reps:
+            for v in range(self.virtual_nodes):
+                ring.append((zlib.crc32(f"{r.name}#{v}".encode())
+                             & 0xFFFFFFFF, r.name))
+        ring.sort()
+        return ring
+
+    def _route(self, tracked: _Tracked) -> Replica:
+        """Affine replica by consistent hash, spilled to the least-loaded
+        one when the affine queue is deeper than ``spill_depth``."""
+        reps = self._serving()
+        if not reps:
+            raise NoHealthyReplica("no serving replica")
+        by_name = {r.name: r for r in reps}
+        ring = self._ring_for(reps)
+        point = zlib.crc32(tracked.text.tobytes()) & 0xFFFFFFFF
+        i = bisect.bisect_left(ring, (point, "")) % len(ring)
+        affine = by_name[ring[i][1]]
+        if len(reps) > 1:
+            loads = {r.name: r.server.backlog() for r in reps}
+            if loads[affine.name]["queued_total"] > self.spill_depth:
+                spill = min(reps, key=lambda r: (
+                    loads[r.name]["queued_total"] + loads[r.name]["running"],
+                    r.name))
+                if spill.name != affine.name:
+                    self._emit("router", "spill",
+                               rid=tracked.handle.request_id,
+                               frm=affine.name, to=spill.name,
+                               depth=loads[affine.name]["queued_total"])
+                    return spill
+        return affine
+
+    def _dispatch(self, tracked: _Tracked) -> None:
+        tracked.attempts += 1
+        attempt = tracked.attempts
+        try:
+            faults.fire("router_submit")
+            replica = self._route(tracked)
+            sub = replica.server.submit(
+                tracked.text, slo=tracked.slo,
+                temperature=tracked.temperature, key=tracked.key)
+        except (faults.InjectedFault, ServerStopped, NoHealthyReplica) as e:
+            # transient dispatch failure: injected, raced a drain/stop,
+            # or an empty rotation — back off and retry, bounded
+            self._schedule_retry(tracked, e)
+            return
+        tracked.replica = replica.name
+        tracked.handle.trail.append((replica.name, attempt))
+        rid = tracked.handle.request_id
+        self._emit("router", "dispatch", rid=rid, replica=replica.name,
+                   attempt=attempt, sub_rid=sub.request_id)
+        sub.future.add_done_callback(
+            lambda f, rid=rid: self._on_done(rid, f))
+
+    # --- resolution (exactly once) -----------------------------------------
+
+    def _on_done(self, rid: int, f: concurrent.futures.Future) -> None:
+        with self._lock:
+            tracked = self._tracked.get(rid)
+        if tracked is None or tracked.resolved:
+            # dedup by request id: a late completion from a replica
+            # presumed dead arrives AFTER the retry resolved the future —
+            # dropped, the caller saw exactly one resolution
+            return
+        exc = f.exception()
+        if exc is None:
+            self._resolve(tracked, f.result(0))  # done: never waits
+        elif isinstance(exc, (ServerStopped, faults.InjectedFault)):
+            # the replica died/drained under the request, or an injected
+            # transient hit it mid-decode: resubmit from prefill elsewhere
+            self._schedule_retry(tracked, exc)
+        else:
+            err = RequestFailed(
+                f"request {rid} failed non-transiently on "
+                f"{tracked.replica}: {exc!r}")
+            err.__cause__ = exc
+            self._reject(tracked, err)
+
+    def _schedule_retry(self, tracked: _Tracked, exc: BaseException) -> None:
+        rid = tracked.handle.request_id
+        if self._closing:
+            err = RouterError("router closed while retrying")
+            err.__cause__ = exc
+            self._reject(tracked, err)
+            return
+        if tracked.attempts > self.max_retries:
+            err = RetriesExhausted(
+                f"request {rid}: {tracked.attempts} attempts failed "
+                f"(max_retries={self.max_retries}); last: {exc!r}")
+            err.__cause__ = exc
+            self._reject(tracked, err)
+            return
+        delay = min(self.retry_backoff_s * (2 ** (tracked.attempts - 1)),
+                    self.retry_backoff_cap_s)
+        due = self._time() + delay
+        with self._lock:
+            heapq.heappush(self._retries, (due, rid))
+        self.retries_total += 1
+        self._emit("router", "retry", rid=rid, attempt=tracked.attempts,
+                   delay_s=round(delay, 4), replica=tracked.replica,
+                   error=repr(exc))
+        reg = obs_metrics.active()
+        if reg is not None:
+            reg.counter("graft_router_retries_total",
+                        "request resubmissions").inc()
+
+    def _resolve(self, tracked: _Tracked, codes) -> None:
+        with self._lock:
+            if tracked.resolved:
+                return
+            tracked.resolved = True
+            self._tracked.pop(tracked.handle.request_id, None)
+        self.resolved_ok += 1
+        self._emit("router", "resolve", rid=tracked.handle.request_id,
+                   replica=tracked.replica, attempts=tracked.attempts,
+                   latency_s=self._time() - tracked.handle.submitted_at)
+        self._count_outcome("ok", tracked.slo)
+        tracked.handle.future.set_result(codes)
+
+    def _reject(self, tracked: _Tracked, err: BaseException) -> None:
+        with self._lock:
+            if tracked.resolved:
+                return
+            tracked.resolved = True
+            self._tracked.pop(tracked.handle.request_id, None)
+        self.resolved_err += 1
+        self._emit("router", "fail", rid=tracked.handle.request_id,
+                   replica=tracked.replica, attempts=tracked.attempts,
+                   error=repr(err))
+        self._count_outcome("error", tracked.slo)
+        tracked.handle.future.set_exception(err)
+
+    def _count_outcome(self, outcome: str, slo: str) -> None:
+        self._set_inflight_gauge()
+        reg = obs_metrics.active()
+        if reg is not None:
+            reg.counter("graft_router_resolved_total",
+                        "router futures resolved", outcome=outcome,
+                        slo=slo).inc()
+
+    def _set_inflight_gauge(self) -> None:
+        reg = obs_metrics.active()
+        if reg is not None:
+            with self._lock:
+                n = len(self._tracked)
+            reg.gauge("graft_router_inflight",
+                      "requests admitted and not yet resolved").set(n)
+
+    # --- health / drain monitoring -----------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_evt.wait(self.monitor_interval_s):
+            try:
+                self.poll()
+            # graftlint: disable=EXC001 (the monitor must survive any single poll error; it is reported in-band as a router event and the next pass retries)
+            except Exception as e:
+                self._emit("router", "monitor_error", error=repr(e))
+
+    def poll(self) -> None:
+        """One monitor pass: detect dead replicas (heartbeat), probe
+        health, account drain grace, release due retries.  The monitor
+        thread calls this every ``monitor_interval_s``; tests call it
+        directly for determinism."""
+        now = self._time()
+        with self._lock:
+            reps = list(self._replicas.values())
+        for r in reps:
+            state = r.state
+            if state == SERVING and (
+                    not r.alive()
+                    or r.beat_age() > self.heartbeat_timeout_s):
+                # policy 2 — heartbeat: the driver is a corpse (thread
+                # dead) or wedged past the timeout; immediate DEAD, every
+                # in-flight future failed typed, migrated by the retries
+                reason = ("driver thread died" if not r.alive()
+                          else f"heartbeat stale {r.beat_age():.2f}s")
+                self._declare_dead(r, reason=reason)
+            elif state == DRAINING:
+                deadline = self._drains.get(r.name)
+                if not r.server.busy:
+                    left = r.finish_drain()
+                    self._drains.pop(r.name, None)
+                    self._emit("router", "drain_complete", replica=r.name,
+                               in_grace=True, migrated=len(left))
+                elif deadline is not None and now > deadline:
+                    unfinished = r.halt(ReplicaDown(
+                        f"replica {r.name}: drain grace expired"))
+                    self._drains.pop(r.name, None)
+                    self._emit("router", "drain_expired", replica=r.name,
+                               migrated=len(unfinished))
+        if now - self._last_probe >= self.probe_every_s:
+            self._last_probe = now
+            for r in reps:
+                if r.state != SERVING:
+                    continue
+                # policy 3 — active probe: consecutive failures start a
+                # graceful drain (quarantine), never an instant kill — a
+                # sick-but-beating replica can still finish its slots
+                hz = r.healthz()
+                if hz.get("ok"):
+                    self._probe_fail[r.name] = 0
+                else:
+                    n = self._probe_fail[r.name] = \
+                        self._probe_fail.get(r.name, 0) + 1
+                    self._emit("router", "probe_fail", replica=r.name,
+                               consecutive=n)
+                    if n >= self.probe_failures:
+                        self.drain(r.name,
+                                   reason=f"healthz failed x{n}")
+        due: List[int] = []
+        with self._lock:
+            while self._retries and self._retries[0][0] <= now:
+                due.append(heapq.heappop(self._retries)[1])
+        for rid in due:
+            with self._lock:
+                tracked = self._tracked.get(rid)
+            if tracked is not None and not tracked.resolved:
+                self._dispatch(tracked)
+
+    def _declare_dead(self, replica: Replica, *, reason: str) -> None:
+        self.replica_deaths += 1
+        telemetry.note(
+            "router", "replica_dead",
+            f"replica {replica.name} declared dead ({reason}); migrating "
+            "its in-flight requests", prefix="[router]",
+            replica=replica.name, reason=reason)
+        reg = obs_metrics.active()
+        if reg is not None:
+            reg.counter("graft_router_replica_deaths_total",
+                        "replicas declared dead", replica=replica.name
+                        ).inc()
+        # halt fails every in-flight future with ReplicaDown; the done
+        # callbacks schedule their retries before halt returns
+        replica.halt(ReplicaDown(
+            f"replica {replica.name} dead ({reason})"))
+
+    def drain(self, name: str, *, grace_s: Optional[float] = None,
+              reason: str = "operator drain") -> Replica:
+        """Begin draining ``name``: stop admitting, migrate the queued
+        backlog now, give running slots ``grace_s`` (default
+        ``drain_grace_s``) to finish before :meth:`poll` hard-halts and
+        migrates them too — the rc-74 notice/grace/kill contract applied
+        to serving."""
+        with self._lock:
+            replica = self._replicas[name]
+        grace = self.drain_grace_s if grace_s is None else float(grace_s)
+        self._drains[name] = self._time() + grace
+        self._emit("router", "drain_begin", replica=name, grace_s=grace,
+                   reason=reason)
+        replica.begin_drain(reason=reason)
+        return replica
+
+    # --- accounting --------------------------------------------------------
+
+    def audit(self) -> dict:
+        """The zero-dropped-futures ledger: ``submitted == resolved_ok +
+        resolved_err + shed + outstanding`` must always hold
+        (``balanced``); the chaos gate asserts it with outstanding == 0
+        after the traffic settles."""
+        with self._lock:
+            outstanding = len(self._tracked)
+            submitted = self._next_rid
+        shed_total = sum(self.shed.values())
+        return dict(
+            submitted=submitted, resolved_ok=self.resolved_ok,
+            resolved_err=self.resolved_err, shed=shed_total,
+            shed_by_class=dict(self.shed), outstanding=outstanding,
+            retries=self.retries_total, replica_deaths=self.replica_deaths,
+            balanced=(submitted == self.resolved_ok + self.resolved_err
+                      + shed_total + outstanding))
+
+    def stats(self) -> dict:
+        """Fleet snapshot: per-replica lifecycle + load, plus the audit
+        ledger — what ``monitor --fleet --metrics`` renders from the
+        scrape side."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        return dict(
+            replicas={r.name: dict(state=r.state, alive=r.alive(),
+                                   beat_age_s=round(r.beat_age(), 3),
+                                   ticks=r.ticks, **r.server.backlog())
+                      for r in reps},
+            **self.audit())
+
+    def _emit(self, kind: str, name: str, **fields):
+        return telemetry.emit(kind, name, **fields)

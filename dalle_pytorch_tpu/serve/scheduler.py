@@ -33,6 +33,14 @@ SLO accounting per request: queue wait (submit -> last admit), decode time
 (last admit -> finish), end-to-end latency, preemption count.
 :meth:`stats` aggregates p50/p99 latency, occupancy, and decoded-token
 throughput — the ``bench_serve`` row schema (PERF.md).
+
+Lifecycle: :meth:`GenerationServer.evict_queued` (stop admitting, fail
+the queued backlog typed — the drain-migration half) and
+:meth:`GenerationServer.stop` (fail everything in flight typed) uphold
+the no-hung-future contract the fleet tier (serve/replica.py +
+serve/router.py) is built on: a future handed out by ``submit`` ALWAYS
+resolves — with codes or with a typed error — whatever happens to the
+server behind it.
 """
 from __future__ import annotations
 
@@ -54,6 +62,15 @@ from .engine import SlotArena
 LATENCY = "latency"
 THROUGHPUT = "throughput"
 SLO_CLASSES = (LATENCY, THROUGHPUT)
+
+
+class ServerStopped(RuntimeError):
+    """Typed terminal error for a request a server will never finish: the
+    server stopped (or started draining) with the request still queued or
+    mid-decode.  The future RESOLVES with this — a caller blocked on
+    ``handle.result()`` gets an exception immediately instead of hanging
+    forever on a decode that will never run; a fleet router treats it as
+    the retry-elsewhere signal (serve/router.py)."""
 
 
 @dataclasses.dataclass
@@ -99,9 +116,18 @@ class GenerationServer:
                  filter_thres: float = 0.9, top_p: Optional[float] = None,
                  seed: int = 0, time_fn=time.monotonic,
                  slo_targets: Optional[Dict[str, float]] = None,
-                 tick_sample: int = 1):
+                 tick_sample: int = 1, tel=None,
+                 metrics_labels: Optional[Dict[str, str]] = None):
         self.arena = SlotArena(dalle, variables, num_slots,
                                filter_thres=filter_thres, top_p=top_p)
+        # tel: an explicit obs.telemetry.Telemetry instance to emit into
+        # (a fleet replica's own per-stream lane); None = the module
+        # singleton, the single-server deployment shape.  metrics_labels
+        # ride every direct-instrumented series (e.g. {"replica": "r0"})
+        # so N servers in one process don't clobber one another's gauges;
+        # the default empty dict keeps the legacy series names bit-for-bit.
+        self._tel = tel
+        self._metrics_labels = dict(metrics_labels or {})
         self.num_slots = num_slots
         # telemetry tick sampling: emit one aggregate `serve tick` record
         # per `tick_sample` decode ticks instead of 1:1 — a week-long serve
@@ -126,6 +152,8 @@ class GenerationServer:
         self._running: Dict[int, _Running] = {}       # slot -> running
         self._free: List[int] = list(range(num_slots))
         self._next_id = 0
+        self._stopped = False
+        self._draining = False
         self.completed: List[ServeHandle] = []
         self.failed: List[ServeHandle] = []
         self.preemption_count = 0
@@ -133,6 +161,20 @@ class GenerationServer:
         self._clock = 0   # arena tick counter: the phase-aligned write column
         self._occupied_slot_ticks = 0
         self._decoded_tokens = 0
+
+    # --- telemetry plumbing -------------------------------------------------
+
+    def _emit(self, kind: str, name: str, **fields):
+        """Emit into this server's own stream when one was given (the
+        fleet tier: one lane per replica), else the module singleton."""
+        if self._tel is not None:
+            return self._tel.event(kind, name, **fields)
+        return telemetry.emit(kind, name, **fields)
+
+    def _span(self, kind: str, name: str, **fields):
+        if self._tel is not None:
+            return self._tel.span(kind, name, **fields)
+        return telemetry.span(kind, name, **fields)
 
     # --- submission --------------------------------------------------------
 
@@ -151,6 +193,13 @@ class GenerationServer:
         assert text.shape[0] == 1, (
             f"one prompt per request; got batch {text.shape[0]}")
         with self._lock:
+            if self._stopped or self._draining:
+                # typed refusal, never a queued future nobody will serve:
+                # a router that raced a drain/stop retries elsewhere
+                raise ServerStopped(
+                    "server is "
+                    + ("stopped" if self._stopped else "draining")
+                    + "; not admitting new requests")
             rid = self._next_id
             self._next_id += 1
             handle = ServeHandle(
@@ -161,14 +210,15 @@ class GenerationServer:
                 submitted_at=self._time())
             self._queues[slo].append(handle)
             depth = len(self._queues[slo])
-        telemetry.emit("serve", "submit", rid=rid, slo=slo)
+        self._emit("serve", "submit", rid=rid, slo=slo)
         # queue depth is THE admission-feedback signal a front-end router
         # consumes (per-replica load); direct-instrumented (not derived
         # from events) so it works with telemetry off and never lags
         reg = obs_metrics.active()
         if reg is not None:
             reg.gauge("graft_serve_queue_depth",
-                      "queued requests awaiting a slot", slo=slo).set(depth)
+                      "queued requests awaiting a slot", slo=slo,
+                      **self._metrics_labels).set(depth)
         return handle
 
     # --- scheduler iteration ----------------------------------------------
@@ -251,7 +301,7 @@ class GenerationServer:
                 self._free.append(slot)
                 self.completed.append(h)
                 target = self.slo_targets.get(h.slo)
-                telemetry.emit(
+                self._emit(
                     "serve", "retire", rid=h.request_id, slot=slot,
                     slo=h.slo, tokens=run.done, latency_s=h.latency,
                     queue_wait_s=(h.admitted_at - h.submitted_at
@@ -264,15 +314,17 @@ class GenerationServer:
                 reg = obs_metrics.active()
                 if reg is not None and h.latency is not None:
                     reg.histogram("graft_serve_latency_seconds",
-                                  "end-to-end request latency",
-                                  slo=h.slo).observe(h.latency)
+                                  "end-to-end request latency", slo=h.slo,
+                                  **self._metrics_labels).observe(h.latency)
                     reg.counter("graft_serve_retired_total",
-                                "completed requests", slo=h.slo).inc()
+                                "completed requests", slo=h.slo,
+                                **self._metrics_labels).inc()
                     if target is not None:
                         reg.counter(
                             "graft_serve_slo_total",
                             "retirements by SLO verdict", slo=h.slo,
-                            ok=str(bool(h.latency <= target)).lower()).inc()
+                            ok=str(bool(h.latency <= target)).lower(),
+                            **self._metrics_labels).inc()
                 h.future.set_result(codes)
 
     def _fail(self, slot: int, exc: BaseException) -> None:
@@ -280,8 +332,8 @@ class GenerationServer:
         self._free.append(slot)
         run.handle.finished_at = self._time()
         self.failed.append(run.handle)
-        telemetry.emit("serve", "fail", rid=run.handle.request_id, slot=slot,
-                       slo=run.handle.slo, tokens=run.done, error=repr(exc))
+        self._emit("serve", "fail", rid=run.handle.request_id, slot=slot,
+                   slo=run.handle.slo, tokens=run.done, error=repr(exc))
         run.handle.future.set_exception(exc)
 
     def _preempt_one_throughput(self) -> Optional[int]:
@@ -298,9 +350,9 @@ class GenerationServer:
         self._free.append(slot)
         run.handle.preemptions += 1
         self.preemption_count += 1
-        telemetry.emit("serve", "preempt", rid=run.handle.request_id,
-                       slot=slot, tokens=run.done,
-                       preemptions=run.handle.preemptions)
+        self._emit("serve", "preempt", rid=run.handle.request_id,
+                   slot=slot, tokens=run.done,
+                   preemptions=run.handle.preemptions)
         with self._lock:
             self._queues[THROUGHPUT].appendleft(run.handle)
         return slot
@@ -324,7 +376,7 @@ class GenerationServer:
             self._admit(handle)
 
     def _admit(self, handle: ServeHandle) -> None:
-        with telemetry.span("serve", "prefill", rid=handle.request_id):
+        with self._span("serve", "prefill", rid=handle.request_id):
             first_logits, caches = self.arena.prefill(
                 jnp.asarray(handle.text))
         slot = self._free.pop()
@@ -333,17 +385,17 @@ class GenerationServer:
         self.arena.admit(slot, first_logits, caches, handle.key,
                          handle.temperature, self._clock)
         handle.admitted_at = self._time()
-        telemetry.emit("serve", "admit", rid=handle.request_id, slot=slot,
-                       slo=handle.slo,
-                       queue_wait_s=handle.admitted_at - handle.submitted_at,
-                       preemptions=handle.preemptions)
+        self._emit("serve", "admit", rid=handle.request_id, slot=slot,
+                   slo=handle.slo,
+                   queue_wait_s=handle.admitted_at - handle.submitted_at,
+                   preemptions=handle.preemptions)
         reg = obs_metrics.active()
         if reg is not None:
             with self._lock:
                 depth = len(self._queues[handle.slo])
             reg.gauge("graft_serve_queue_depth",
                       "queued requests awaiting a slot",
-                      slo=handle.slo).set(depth)
+                      slo=handle.slo, **self._metrics_labels).set(depth)
         self._running[slot] = _Running(handle=handle, done=1)
         self._decoded_tokens += 1  # admit samples the request's first code
 
@@ -398,24 +450,117 @@ class GenerationServer:
         agg = self._tick_agg
         if not agg["ticks"]:
             return
-        telemetry.emit("serve", "tick", clock=self._clock - 1,
-                       active=agg["active_sum"] / agg["ticks"],
-                       ticks=agg["ticks"], active_sum=agg["active_sum"],
-                       active_min=agg["active_min"],
-                       active_max=agg["active_max"],
-                       clock_first=agg["clock_first"])
+        self._emit("serve", "tick", clock=self._clock - 1,
+                   active=agg["active_sum"] / agg["ticks"],
+                   ticks=agg["ticks"], active_sum=agg["active_sum"],
+                   active_min=agg["active_min"],
+                   active_max=agg["active_max"],
+                   clock_first=agg["clock_first"])
         reg = obs_metrics.active()
         if reg is not None:
             reg.gauge("graft_serve_occupancy",
-                      "occupied-slot fraction over the last tick window"
+                      "occupied-slot fraction over the last tick window",
+                      **self._metrics_labels
                       ).set(agg["active_sum"]
                             / (agg["ticks"] * self.num_slots))
-            reg.counter("graft_serve_ticks_total", "decode ticks run"
-                        ).inc(agg["ticks"])
+            reg.counter("graft_serve_ticks_total", "decode ticks run",
+                        **self._metrics_labels).inc(agg["ticks"])
         self._tick_agg = {"ticks": 0, "active_sum": 0, "active_min": None,
                           "active_max": 0, "clock_first": None}
 
+    # --- lifecycle: drain / stop -------------------------------------------
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _zero_queue_gauges(self) -> None:
+        reg = obs_metrics.active()
+        if reg is not None:
+            for slo in SLO_CLASSES:
+                reg.gauge("graft_serve_queue_depth",
+                          "queued requests awaiting a slot", slo=slo,
+                          **self._metrics_labels).set(0)
+
+    def evict_queued(self, error: Optional[BaseException] = None
+                     ) -> List[ServeHandle]:
+        """Drain, step 1: refuse new admissions and fail every QUEUED (not
+        yet admitted) request's future with a typed error — the
+        migrate-the-backlog half of the drain protocol.  Running slots
+        keep decoding: they either finish inside the drain grace window or
+        are failed-and-migrated by :meth:`stop` when it closes.  Returns
+        the evicted handles."""
+        err = (error if error is not None
+               else ServerStopped("request evicted: server draining"))
+        with self._lock:
+            self._draining = True
+            evicted = [h for slo in SLO_CLASSES for h in self._queues[slo]]
+            for q in self._queues.values():
+                q.clear()
+        for h in evicted:
+            h.finished_at = self._time()
+            self.failed.append(h)
+            self._emit("serve", "evicted", rid=h.request_id, slo=h.slo,
+                       error=repr(err))
+        self._zero_queue_gauges()
+        # exceptions are set OUTSIDE every lock: done-callbacks (a fleet
+        # router's retry path) run synchronously on this thread and may
+        # submit to OTHER servers
+        for h in evicted:
+            h.future.set_exception(err)
+        return evicted
+
+    def stop(self, error: Optional[BaseException] = None
+             ) -> List[ServeHandle]:
+        """Stop serving: fail EVERY queued and running request's future
+        with a typed error (default :class:`ServerStopped`) so no caller
+        blocks forever on a decode that will never run — the
+        blocked-forever shutdown bug this method exists to close.  Later
+        :meth:`submit` calls raise the same typed error immediately.
+
+        Must be called from the driving thread, or after the driving loop
+        has exited (a fleet replica joins its driver first) — it reclaims
+        the running slots' bookkeeping.  Returns the unfinished handles;
+        idempotent (a second stop returns [])."""
+        err = (error if error is not None
+               else ServerStopped("server stopped with requests in flight"))
+        with self._lock:
+            self._stopped = True
+            self._draining = True
+            unfinished = [h for slo in SLO_CLASSES
+                          for h in self._queues[slo]]
+            for q in self._queues.values():
+                q.clear()
+        for slot in sorted(self._running):
+            run = self._running.pop(slot)
+            self._free.append(slot)
+            unfinished.append(run.handle)
+        for h in unfinished:
+            h.finished_at = self._time()
+            self.failed.append(h)
+            self._emit("serve", "stopped", rid=h.request_id, slo=h.slo,
+                       error=repr(err))
+        self._flush_tick_agg()
+        self._zero_queue_gauges()
+        # same outside-the-lock discipline as evict_queued
+        for h in unfinished:
+            h.future.set_exception(err)
+        return unfinished
+
     # --- metrics ------------------------------------------------------------
+
+    def backlog(self) -> dict:
+        """Cheap load feedback for a fleet router: queued requests per SLO
+        class plus the running-slot count — no percentile math (that is
+        :meth:`stats`), so it can be polled per routing decision."""
+        with self._lock:
+            queued = {slo: len(self._queues[slo]) for slo in SLO_CLASSES}
+        return dict(queued=queued, queued_total=sum(queued.values()),
+                    running=len(self._running))
 
     def trace_counts(self) -> dict:
         return self.arena.trace_counts()

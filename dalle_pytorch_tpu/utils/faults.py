@@ -27,6 +27,11 @@ reads, ``sigterm`` in the trainers' step loops).  Actions:
 * ``at_step=N`` — fires once when the caller passes ``step == N``;
   :func:`maybe_kill` turns it into a real ``SIGTERM`` to this process
   (the preemption notice, mid-training).
+* ``at_tick=N`` — ``at_step`` for callers whose progress coordinate is a
+  *tick counter*, not a training step (the serve fleet's replica driver
+  loops).  Same one-shot semantics, distinct spelling so a chaos spec
+  reads unambiguously: ``replica_down:at_tick=40`` kills a replica at
+  its 40th driver tick, whatever training step anything else is on.
 * ``grace_ms=N`` — configuration, not a trigger: the grace window (in
   milliseconds) the ``preempt`` site pairs with its ``at_step``.
 
@@ -70,6 +75,22 @@ injected failure mid-decode fails THAT request — its future carries the
 fault, its slot frees the same scheduler iteration — while co-batched
 requests keep decoding (tests/test_serve.py pins the isolation).
 
+Fleet-serving sites (serve/replica.py + serve/router.py):
+``replica_down`` is hit once per replica driver-loop pass (``step`` =
+that replica's completed DECODE-tick count, so ``at_tick=N`` lands
+mid-stream after the Nth decode tick — an idle loop spins far faster
+than it decodes); ``at_tick=N`` makes the driver thread
+*vanish* mid-decode — no cleanup, no future resolution — so the router's
+failure detectors (heartbeat staleness, ``/healthz``) are what find the
+corpse, exactly like a killed pod; ``every=K`` models a crashy driver
+loop instead.  ``router_submit`` is hit once per dispatch attempt inside
+``FleetRouter``; ``every=K`` makes dispatches fail transiently, driving
+the bounded-retry/backoff path (``every=1`` = retry exhaustion).
+``replica_health`` is hit once per ``Replica.healthz()`` probe; ``every``
+makes the probe fail while the driver keeps beating — the
+probe-signal-without-heartbeat-signal case the router must treat as a
+graceful quarantine, not an instant death.
+
 Counters are per-site and thread-safe (dataset reads run under the
 prefetching DataLoader's thread pool).  The registry is parsed lazily from
 the environment; trainers call :func:`install_from_env` at startup so
@@ -86,7 +107,8 @@ from typing import Dict, FrozenSet, List, Optional
 
 from ..obs import telemetry
 
-_ACTIONS = ("fail_after", "every", "truncate", "at_step", "grace_ms")
+_ACTIONS = ("fail_after", "every", "truncate", "at_step", "at_tick",
+            "grace_ms")
 
 
 class InjectedFault(OSError):
@@ -184,10 +206,12 @@ class FaultRegistry:
                     if not t.fired and hits == t.value:
                         t.fired = True
                         actions.add("truncate")
-                elif t.action == "at_step":
+                elif t.action in ("at_step", "at_tick"):
+                    # same one-shot progress trigger; at_tick is the
+                    # spelling for tick-counter callers (replica drivers)
                     if not t.fired and step is not None and step == t.value:
                         t.fired = True
-                        actions.add("at_step")
+                        actions.add(t.action)
             for action in actions:
                 _record(site, action, hits, step)
             return frozenset(actions)

@@ -98,6 +98,47 @@ def test_maybe_kill_delivers_sigterm_at_step():
         signal.signal(signal.SIGTERM, prev)
 
 
+# --- fleet-serving faultpoint grammar (ISSUE 12) --------------------------
+
+
+def test_at_tick_fires_once_at_matching_tick():
+    """replica_down:at_tick=N — the at_step one-shot semantics keyed on a
+    tick counter: fires exactly when the caller's tick equals N, once."""
+    reg = FaultRegistry("replica_down:at_tick=3")
+    assert reg.fire("replica_down", step=1) == frozenset()
+    assert reg.fire("replica_down", step=2) == frozenset()
+    assert reg.fire("replica_down", step=3) == frozenset({"at_tick"})
+    assert reg.fire("replica_down", step=3) == frozenset()  # one-shot
+    assert reg.fire("replica_down", step=4) == frozenset()
+
+
+def test_at_tick_and_at_step_are_distinct_actions():
+    """A spec can aim at_step at a trainer and at_tick at a replica on
+    the same registry without crosstalk, and each reports its own name."""
+    reg = FaultRegistry("replica_down:at_tick=2,sigterm:at_step=2")
+    assert reg.fire("replica_down", step=2) == frozenset({"at_tick"})
+    assert reg.fire("sigterm", step=2) == frozenset({"at_step"})
+
+
+def test_router_submit_every_is_periodic():
+    """router_submit:every=K — every K-th dispatch raises (the router's
+    bounded-retry driver; every=1 is retry exhaustion)."""
+    reg = FaultRegistry("router_submit:every=2")
+    failures = 0
+    for _ in range(6):
+        try:
+            reg.fire("router_submit")
+        except InjectedFault:
+            failures += 1
+    assert failures == 3  # hits 2, 4, 6
+
+
+def test_replica_health_site_rides_every_grammar():
+    reg = FaultRegistry("replica_health:every=1")
+    with pytest.raises(InjectedFault):
+        reg.fire("replica_health")
+
+
 # --- data-loader graceful degradation ------------------------------------
 
 

@@ -420,6 +420,53 @@ def test_obs002_pragma_with_reason_suppresses():
                        path="dalle_pytorch_tpu/utils/x.py") == []
 
 
+# --- SRV001 --------------------------------------------------------------
+
+
+def test_srv001_blocking_waits_without_timeout_flagged():
+    """future.result() / queue.get() / lock.acquire() with no timeout in
+    serve/ are the hang a dead replica turns into — all three forms
+    flagged."""
+    src = """
+    def wait_all(fut, q, lock):
+        a = fut.result()
+        b = q.get()
+        lock.acquire()
+        return a, b
+    """
+    found = lint(src, select=("SRV001",),
+                 path="dalle_pytorch_tpu/serve/router.py")
+    assert rules_of(found) == ["SRV001"] * 3
+
+
+def test_srv001_bounded_waits_and_out_of_scope_clean():
+    """Timeouts (positional or keyword), keyed dict .get, and the same
+    blocking forms OUTSIDE serve/ all stay clean."""
+    bounded = """
+    def wait_all(fut, q, lock, d):
+        a = fut.result(5.0)
+        b = fut.result(timeout=2.0)
+        c = q.get(timeout=0.1)
+        lock.acquire(timeout=1.0)
+        return a, b, c, d.get("key"), os.environ.get("X", "")
+    """
+    assert lint(bounded, select=("SRV001",),
+                path="dalle_pytorch_tpu/serve/scheduler.py") == []
+    blocking = "x = fut.result()\ny = q.get()\n"
+    for path in ("dalle_pytorch_tpu/utils/faults.py", "tools/monitor.py",
+                 "train_dalle.py", "tests/test_router.py"):
+        assert lint_source(blocking, select=("SRV001",), path=path) == [], \
+            path
+
+
+def test_srv001_pragma_with_reason_suppresses():
+    src = ("done = fut.result()  "
+           "# graftlint: disable=SRV001 (the future is already done: "
+           "resolved by the callback that called us)\n")
+    assert lint_source(src, select=("SRV001",),
+                       path="dalle_pytorch_tpu/serve/router.py") == []
+
+
 # --- engine machinery ----------------------------------------------------
 
 
@@ -859,7 +906,8 @@ def test_every_rule_has_fixture_coverage():
     """Meta: the rule registry and this file stay in sync — adding a rule
     without positive-fixture coverage fails here."""
     covered = {"ENV001", "SEED001", "BACKEND001", "DOT001", "TRACE001",
-               "EXC001", "CKPT001", "OBS001", "OBS002", "DON001", "DON002"}
+               "EXC001", "CKPT001", "OBS001", "OBS002", "SRV001", "DON001",
+               "DON002"}
     assert covered == set(RULES)
 
 

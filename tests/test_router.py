@@ -1,0 +1,586 @@
+"""Fleet router chaos matrix (serve/router.py + serve/replica.py).
+
+The load-bearing contract, in order:
+
+* **Zero dropped futures** — every ``FleetRouter.submit`` future resolves
+  EXACTLY ONCE with decoded codes, a typed ``ShedError`` (immediate, at
+  admission), or a typed ``RouterError`` — under replica kill, rolling
+  drain/join, saturation, and retry exhaustion.  ``audit()['balanced']``
+  is the ledger form of the same claim.
+* **Bit-match** — surviving requests produce codes BIT-IDENTICAL to the
+  single-server (and therefore static-sampler) path: routing, migration
+  and retries are scheduling changes, not model changes.  A retried
+  request replays from prefill with its pinned key, so migration cannot
+  change its bits.
+* **Typed failure detection** — the three signals (future exception,
+  heartbeat staleness, /healthz probe) each drive their own policy:
+  per-request retry, immediate declare-dead + migrate, graceful drain.
+
+Replicas are in-process driver threads over their own SlotArenas (the
+chip-free fleet tier); tools/fleet_smoke.py is the multi-process leg the
+CI crash-resume job runs.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu import DALLE, DALLEConfig, VAEConfig
+from dalle_pytorch_tpu.models.dalle import decode_codes, prefill_codes
+from dalle_pytorch_tpu.serve import (DEAD, DRAINING, LATENCY, SERVING,
+                                     THROUGHPUT, FleetRouter, Replica,
+                                     ReplicaDown, RetriesExhausted,
+                                     RouterError, ShedError)
+from dalle_pytorch_tpu.serve.router import _Tracked
+from dalle_pytorch_tpu.utils import faults
+
+VCFG = VAEConfig(image_size=16, num_tokens=32, codebook_dim=16, num_layers=2,
+                 hidden_dim=8)
+
+# generous: every wait in this file is bounded (the no-hang contract is
+# the thing under test), sized for a loaded CI box
+WAIT_S = 120.0
+NO_SHED = {LATENCY: 10_000, THROUGHPUT: 10_000}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.install("")
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def small():
+    """Tiny two-pattern model + greedy single-server references."""
+    cfg = DALLEConfig.from_vae(
+        VCFG, dim=32, num_text_tokens=50, text_seq_len=6, depth=2, heads=2,
+        dim_head=8, attn_types=("full", "axial_row"))
+    dalle = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    texts = [np.asarray(jax.random.randint(
+        jax.random.PRNGKey(i), (cfg.text_seq_len,), 1, 50), np.int32)
+        for i in range(6)]
+    codes = jax.random.randint(rng, (1, cfg.image_seq_len), 0, 32)
+    params = dalle.init(rng, jnp.asarray(texts[0])[None], codes,
+                        return_loss=True)
+    prefill = jax.jit(lambda p, t: prefill_codes(dalle, p, t))
+
+    def greedy_ref(i):
+        fl, caches = prefill(params, jnp.asarray(texts[i])[None])
+        return np.asarray(decode_codes(
+            dalle, params, fl, caches, jax.random.PRNGKey(7),
+            filter_thres=1.0))[0]
+
+    refs = [greedy_ref(i) for i in range(len(texts))]
+    return cfg, dalle, params, texts, refs
+
+
+def make_replica(small, name, num_slots=2, **kw):
+    _, dalle, params, texts, _ = small
+    kw.setdefault("filter_thres", 1.0)  # greedy: bit-compare vs references
+    kw.setdefault("warmup_text", texts[0])
+    return Replica(name, dalle, params, num_slots, **kw)
+
+
+def make_router(small, n=2, *, wait=True, names=None, **kw):
+    kw.setdefault("retry_backoff_s", 0.01)
+    kw.setdefault("monitor_interval_s", 0.01)
+    kw.setdefault("probe_every_s", 0.1)
+    kw.setdefault("shed_bounds", dict(NO_SHED))
+    names = names or [f"r{i}" for i in range(n)]
+    router = FleetRouter([make_replica(small, nm) for nm in names], **kw)
+    router.start()
+    if wait:
+        router.wait_serving(n, timeout_s=WAIT_S)
+    return router
+
+
+def assert_zero_dropped(router, handles, refs_of):
+    """The headline gate: every future resolved exactly once (result or
+    typed error) within a bounded wait, the ledger balances with nothing
+    outstanding, and every successful result bit-matches its
+    single-server reference."""
+    import concurrent.futures
+
+    deadline = time.monotonic() + WAIT_S
+    for h in handles:
+        try:
+            h.future.exception(max(0.1, deadline - time.monotonic()))
+        except concurrent.futures.TimeoutError:
+            pass  # converted into the done() failure below
+    for i, h in enumerate(handles):
+        assert h.future.done(), f"request {h.request_id} future never resolved"
+        exc = h.future.exception()
+        if exc is None:
+            np.testing.assert_array_equal(h.result(0), refs_of(i))
+        else:
+            assert isinstance(exc, RouterError), exc  # ShedError included
+    audit = router.audit()
+    assert audit["balanced"], audit
+    assert audit["outstanding"] == 0, audit
+    return audit
+
+
+# --- the happy fleet -------------------------------------------------------
+
+
+def test_fleet_bit_matches_single_server(small):
+    _, _, _, texts, refs = small
+    router = make_router(small, 2)
+    try:
+        hs = [router.submit(texts[i % len(texts)]) for i in range(8)]
+        audit = assert_zero_dropped(router, hs,
+                                    lambda i: refs[i % len(texts)])
+        assert audit["resolved_ok"] == 8 and audit["resolved_err"] == 0
+    finally:
+        router.close()
+
+
+def test_consistent_hash_affinity_and_spill(small):
+    """Same prompt -> same replica while the affine queue is shallow; a
+    deep affine queue spills to the least-loaded replica."""
+    _, _, _, texts, _ = small
+    import concurrent.futures
+
+    from dalle_pytorch_tpu.serve import RouterHandle
+
+    router = make_router(small, 2)
+    try:
+        tracked = _Tracked(handle=RouterHandle(
+            request_id=-1, slo=THROUGHPUT,
+            future=concurrent.futures.Future()),
+            text=texts[0][None], slo=THROUGHPUT, temperature=1.0,
+            key=np.asarray([0, 0], np.uint32))
+        affine = {router._route(tracked).name for _ in range(5)}
+        assert len(affine) == 1  # deterministic affinity on an idle fleet
+        # flood the affine replica's queue directly, past spill_depth
+        # (its own driver thread is live and admitting, so overshoot the
+        # bound; close() fails the flood's futures typed afterwards)
+        name = next(iter(affine))
+        for _ in range(router.spill_depth + 8):
+            router.replica(name).server.submit(texts[0],
+                                               key=np.asarray([9, 9],
+                                                              np.uint32))
+        spilled = router._route(tracked).name
+        assert spilled != name  # load bounds affinity
+    finally:
+        router.close()
+
+
+# --- chaos: kill -----------------------------------------------------------
+
+
+def test_replica_kill_mid_decode_zero_dropped_and_bit_match(small):
+    """The headline chaos row: `replica_down:at_tick` makes one driver
+    thread vanish mid-decode (no cleanup, futures unresolved); the router
+    detects the corpse, fails its in-flight typed, retries elsewhere —
+    zero dropped futures, surviving results bit-identical."""
+    _, _, _, texts, refs = small
+    faults.install("replica_down:at_tick=30")
+    router = make_router(small, 2, heartbeat_timeout_s=0.5)
+    try:
+        hs = [router.submit(texts[i % len(texts)]) for i in range(10)]
+        audit = assert_zero_dropped(router, hs,
+                                    lambda i: refs[i % len(texts)])
+        assert audit["resolved_ok"] == 10  # every request survived
+        assert audit["replica_deaths"] == 1
+        assert audit["retries"] >= 1  # the migration actually happened
+        dead = [n for n, r in router.stats()["replicas"].items()
+                if r["state"] == DEAD]
+        assert len(dead) == 1
+    finally:
+        router.close()
+
+
+def test_idle_corpse_detected_without_request_loss(small):
+    """A replica whose driver CRASHES while idle (step raises — the
+    driver_error exit, not a clean fault return) is detected by liveness
+    alone and leaves the rotation before it can eat a request."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 2, heartbeat_timeout_s=0.3)
+    try:
+        def _boom(*a, **k):
+            raise RuntimeError("injected driver crash")
+
+        router.replica("r0").server.step = _boom
+        deadline = time.monotonic() + WAIT_S
+        while time.monotonic() < deadline:
+            states = {n: r["state"]
+                      for n, r in router.stats()["replicas"].items()}
+            if sorted(states.values()) == [DEAD, SERVING]:
+                break
+            time.sleep(0.01)
+        assert sorted(states.values()) == [DEAD, SERVING], states
+        assert states["r0"] == DEAD
+        hs = [router.submit(texts[i % len(texts)]) for i in range(4)]
+        audit = assert_zero_dropped(router, hs,
+                                    lambda i: refs[i % len(texts)])
+        assert audit["resolved_ok"] == 4
+    finally:
+        router.close()
+
+
+# --- chaos: drain / join ---------------------------------------------------
+
+
+def test_drain_while_loaded_clean_grace(small):
+    """Drain with a wide grace window: queued backlog migrates at once,
+    running slots finish in place, the replica ends DEAD with nothing
+    dropped and everything bit-exact."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 2, drain_grace_s=WAIT_S)
+    try:
+        hs = [router.submit(texts[i % len(texts)]) for i in range(8)]
+        router.drain("r0")
+        audit = assert_zero_dropped(router, hs,
+                                    lambda i: refs[i % len(texts)])
+        assert audit["resolved_ok"] == 8
+        deadline = time.monotonic() + WAIT_S
+        while router.replica("r0").state != DEAD \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert router.replica("r0").state == DEAD
+        assert not router.replica("r0").server.busy
+    finally:
+        router.close()
+
+
+def test_drain_grace_expiry_migrates_running_slots(small):
+    """Zero grace: running slots cannot finish in the window, so they are
+    failed typed (ReplicaDown) and MIGRATED — same results, more retries."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 2)
+    try:
+        hs = [router.submit(texts[i % len(texts)]) for i in range(6)]
+        router.drain("r0", grace_s=0.0)
+        audit = assert_zero_dropped(router, hs,
+                                    lambda i: refs[i % len(texts)])
+        assert audit["resolved_ok"] == 6
+        assert router.replica("r0").state == DEAD
+    finally:
+        router.close()
+
+
+def test_join_under_traffic_takes_load(small):
+    """A replica joined mid-stream warms (JOINING), self-promotes, and
+    then actually receives dispatches — with zero disturbance to the
+    in-flight traffic."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 1)
+    try:
+        hs = [router.submit(texts[i % len(texts)]) for i in range(6)]
+        joined = router.join(make_replica(small, "rj"))
+        deadline = time.monotonic() + WAIT_S
+        while joined.state != SERVING and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert joined.state == SERVING
+        hs += [router.submit(texts[(len(hs) + j) % len(texts)])
+               for j in range(8)]
+        audit = assert_zero_dropped(router, hs,
+                                    lambda i: refs[i % len(texts)])
+        assert audit["resolved_ok"] == 14
+        dispatched = {r for h in hs for (r, _) in h.trail}
+        assert "rj" in dispatched  # the joiner took real traffic
+    finally:
+        router.close()
+
+
+def test_rolling_restart_zero_dropped(small):
+    """Roll EVERY replica in sequence (drain -> dead -> fresh join) under
+    continuous traffic: the original fleet is entirely replaced and not
+    one future is dropped or wrong."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 3, drain_grace_s=WAIT_S)
+    try:
+        hs = []
+        for i, name in enumerate(["r0", "r1", "r2"]):
+            hs += [router.submit(texts[(len(hs) + j) % len(texts)])
+                   for j in range(3)]
+            router.drain(name, reason="rolling restart")
+            deadline = time.monotonic() + WAIT_S
+            while router.replica(name).state != DEAD \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert router.replica(name).state == DEAD
+            joined = router.join(make_replica(small, f"{name}b"))
+            deadline = time.monotonic() + WAIT_S
+            while joined.state != SERVING and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert joined.state == SERVING
+            hs += [router.submit(texts[(len(hs) + j) % len(texts)])
+                   for j in range(3)]
+        audit = assert_zero_dropped(router, hs,
+                                    lambda i: refs[i % len(texts)])
+        assert audit["resolved_ok"] == len(hs)
+        states = {n: r["state"]
+                  for n, r in router.stats()["replicas"].items()}
+        assert all(states[f"r{i}"] == DEAD for i in range(3))
+        assert all(states[f"r{i}b"] == SERVING for i in range(3))
+    finally:
+        router.close()
+
+
+# --- chaos: shed / retry ---------------------------------------------------
+
+
+def test_shed_at_saturation_is_immediate_and_typed(small):
+    """SLO-aware shedding: the latency class's bound trips while the
+    throughput class still flows; a shed future is ALREADY resolved when
+    submit returns (never a hang) and carries the typed ShedError."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 1,
+                         shed_bounds={LATENCY: 0, THROUGHPUT: 10_000})
+    try:
+        h_lat = router.submit(texts[0], slo=LATENCY)
+        assert h_lat.future.done()  # immediate, at submit time
+        exc = h_lat.future.exception()
+        assert isinstance(exc, ShedError)
+        assert (exc.slo, exc.depth, exc.bound) == (LATENCY, 0, 0)
+        h_thr = router.submit(texts[1], slo=THROUGHPUT)
+        np.testing.assert_array_equal(h_thr.result(WAIT_S), refs[1])
+        audit = assert_zero_dropped(router, [h_lat, h_thr],
+                                    lambda i: refs[i])
+        assert audit["shed_by_class"] == {LATENCY: 1, THROUGHPUT: 0}
+    finally:
+        router.close()
+
+
+def test_retry_exhaustion_is_typed_with_cause(small):
+    """router_submit:every=1 fails every dispatch: the future resolves
+    with RetriesExhausted whose __cause__ is the last injected fault, and
+    the attempt count honors the budget exactly."""
+    _, _, _, texts, _ = small
+    faults.install("router_submit:every=1")
+    router = make_router(small, 1, max_retries=2)
+    try:
+        h = router.submit(texts[0])
+        with pytest.raises(RetriesExhausted) as ei:
+            h.result(WAIT_S)
+        assert isinstance(ei.value.__cause__, faults.InjectedFault)
+        assert "3 attempts" in str(ei.value)  # 1 first + 2 retries
+        audit = router.audit()
+        assert audit["balanced"] and audit["resolved_err"] == 1
+    finally:
+        router.close()
+
+
+def test_injected_serve_fault_is_retried_transparently(small):
+    """Policy 1 (future exception): a serve_request fault that fails one
+    request mid-decode on a HEALTHY replica is retried — the caller sees
+    only the correct result, and the replica stays in rotation."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 2)
+    # installed AFTER the warmups: the site counts hits fleet-wide, and a
+    # warmup burning the fail_after budget would leave nothing to inject
+    faults.install("serve_request:fail_after=10")
+    try:
+        hs = [router.submit(texts[i % len(texts)]) for i in range(4)]
+        audit = assert_zero_dropped(router, hs,
+                                    lambda i: refs[i % len(texts)])
+        assert audit["resolved_ok"] == 4
+        assert audit["retries"] >= 1
+        assert audit["replica_deaths"] == 0  # one bad request != death
+        states = {r["state"]
+                  for r in router.stats()["replicas"].values()}
+        assert states == {SERVING}
+    finally:
+        router.close()
+
+
+# --- failure signal: /healthz probe ----------------------------------------
+
+
+def test_replica_health_faultpoint_fails_probe():
+    """Unit: the replica_health site makes healthz() report not-ok
+    without touching the driver (the probe-vs-heartbeat split)."""
+
+    class _Stub(Replica):
+        def __init__(self):  # probe surface only — no model, no thread
+            self.name = "stub"
+            self._time = time.monotonic
+            self.last_beat = self._time()
+            self.ticks = 0
+
+    faults.install("replica_health:every=1")
+    try:
+        hz = _Stub().healthz()
+        assert hz["ok"] is False and "InjectedFault" in hz["error"]
+    finally:
+        faults.reset()
+
+
+def test_probe_failures_drain_gracefully(small):
+    """Policy 3 (active probe): consecutive probe failures on a beating
+    replica start a DRAIN, not a kill — its running work finishes, new
+    traffic goes elsewhere, nothing drops."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 2, probe_every_s=0.02, probe_failures=2,
+                         drain_grace_s=WAIT_S)
+    try:
+        hs = [router.submit(texts[i % len(texts)]) for i in range(4)]
+        sick = router.replica("r0")
+        sick.healthz = lambda: {"ok": False, "replica": "r0"}
+        deadline = time.monotonic() + WAIT_S
+        while sick.state not in (DRAINING, DEAD) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sick.state in (DRAINING, DEAD)
+        hs += [router.submit(texts[(len(hs) + j) % len(texts)])
+               for j in range(4)]
+        audit = assert_zero_dropped(router, hs,
+                                    lambda i: refs[i % len(texts)])
+        assert audit["resolved_ok"] == 8
+        assert audit["replica_deaths"] == 0  # drained, never declared dead
+        late = {r for h in hs[4:] for (r, _) in h.trail}
+        assert late == {"r1"}  # quarantined replica took no new traffic
+    finally:
+        router.close()
+
+
+# --- exactly-once dedup ----------------------------------------------------
+
+
+def test_late_completion_after_resolution_is_dropped(small):
+    """Dedup by request id: a replica-side completion arriving after the
+    router future already resolved is ignored — exactly once, provably."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 1)
+    try:
+        h = router.submit(texts[0])
+        np.testing.assert_array_equal(h.result(WAIT_S), refs[0])
+        import concurrent.futures
+        ghost = concurrent.futures.Future()
+        ghost.set_result(np.zeros_like(refs[0]))  # a wrong, late result
+        router._on_done(h.request_id, ghost)      # must be a no-op
+        np.testing.assert_array_equal(h.result(0), refs[0])
+        assert router.audit()["resolved_ok"] == 1
+    finally:
+        router.close()
+
+
+def test_close_fails_outstanding_futures_typed(small):
+    """Closing the router upholds the contract too: anything unresolved
+    fails with a typed RouterError, never a hang."""
+    _, _, _, texts, _ = small
+    router = make_router(small, 1)
+    hs = [router.submit(texts[i % len(texts)]) for i in range(4)]
+    router.close()
+    for h in hs:
+        assert h.future.done()
+        exc = h.future.exception()
+        assert exc is None or isinstance(exc, RouterError)
+    assert router.audit()["balanced"]
+    assert router.audit()["outstanding"] == 0
+
+
+# --- observability surfaces -------------------------------------------------
+
+
+def test_replica_state_metrics_and_monitor_scrape(small, capsys):
+    """The monitor satellite end to end: replica lifecycle + queue depth
+    + occupancy land on /metrics (per-replica labels), and `monitor
+    --fleet --metrics` folds the scrape into the fleet scan output."""
+    from dalle_pytorch_tpu.obs import metrics as obs_metrics
+    from dalle_pytorch_tpu.obs.telemetry import Telemetry
+
+    reg = obs_metrics.init()
+    server = obs_metrics.serve(0, reg)
+    router = make_router(small, 2)
+    try:
+        _, _, _, texts, refs = small
+        h = router.submit(texts[0])
+        np.testing.assert_array_equal(h.result(WAIT_S), refs[0])
+        router.drain("r1", grace_s=WAIT_S)
+        deadline = time.monotonic() + WAIT_S
+        while router.replica("r1").state != DEAD \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        text = reg.render()
+        assert 'graft_replica_state{replica="r0",state="serving"} 1.0' \
+            in text
+        assert 'graft_replica_state{replica="r1",state="dead"} 1.0' in text
+        assert 'graft_serve_queue_depth{replica="r0"' in text
+        assert "graft_router_submitted_total" in text
+
+        # a minimal telemetry lane so the fleet scan has a stream to align
+        import sys
+        import tempfile
+        from pathlib import Path
+
+        sys.path.insert(0, str(
+            Path(__file__).resolve().parent.parent / "tools"))
+        import monitor
+        with tempfile.TemporaryDirectory() as d:
+            tel = Telemetry(d, run_id="scrape-test")
+            tel.event("step", "step", step=1)
+            tel.close()
+            rc = monitor.fleet_scan(
+                [Path(d)], timeout=1e9,
+                metrics_urls=[f"http://127.0.0.1:{server.port}"])
+        out = capsys.readouterr().out
+        assert "replica r0" in out and "state serving" in out
+        assert "replica r1" in out and "state dead" in out
+        assert rc == 0
+    finally:
+        router.close()
+        server.close()
+        obs_metrics.shutdown()
+
+
+def test_per_replica_telemetry_streams_merge(small, tmp_path):
+    """Fleet request flow in graftscope: each replica writes its own lane
+    (serve submit/admit/retire events), and merge_streams aligns them
+    into one fleet view with one lane per replica."""
+    from dalle_pytorch_tpu.obs import merge_streams
+
+    _, dalle, params, texts, refs = small
+    reps = [Replica(f"m{i}", dalle, params, 2, filter_thres=1.0,
+                    warmup_text=texts[0],
+                    telemetry_dir=tmp_path / f"rep{i}", host_index=i)
+            for i in range(2)]
+    router = FleetRouter(reps, retry_backoff_s=0.01,
+                         monitor_interval_s=0.01,
+                         shed_bounds=dict(NO_SHED)).start()
+    try:
+        router.wait_serving(2, timeout_s=WAIT_S)
+        hs = [router.submit(texts[i % len(texts)]) for i in range(6)]
+        assert_zero_dropped(router, hs, lambda i: refs[i % len(texts)])
+    finally:
+        router.close()
+    events, clocks = merge_streams([tmp_path / "rep0", tmp_path / "rep1"])
+    assert len(clocks) == 2  # one aligned lane per replica
+    kinds = {(r.get("kind"), r.get("name")) for r in events}
+    assert ("serve", "submit") in kinds and ("serve", "retire") in kinds
+    assert ("replica", "state") in kinds
+
+
+@pytest.mark.slow
+def test_fleet_smoke_tool_multi_process(tmp_path):
+    """The multi-process leg: tools/fleet_smoke.py (the CI chaos row) in
+    a subprocess — router over 2 replicas, one killed mid-run, exit 0
+    only on zero dropped futures + bit-match, and per-replica streams on
+    disk for obs_report --merge."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    out = tmp_path / "fleet"
+    proc = subprocess.run(
+        [sys.executable, str(repo / "tools" / "fleet_smoke.py"),
+         "--replicas", "2", "--requests", "10", "--kill-tick", "25",
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "zero dropped futures" in proc.stdout
+    for lane in ("router", "replica0", "replica1"):
+        assert any((out / lane).glob("events*.jsonl*")), lane
+    merge = subprocess.run(
+        [sys.executable, str(repo / "tools" / "obs_report.py"), "--merge",
+         str(out / "router"), str(out / "replica0"), str(out / "replica1")],
+        capture_output=True, text=True, timeout=300)
+    assert merge.returncode == 0, merge.stdout + merge.stderr

@@ -31,7 +31,7 @@ import pytest
 from dalle_pytorch_tpu import DALLE, DALLEConfig, VAEConfig
 from dalle_pytorch_tpu.models.dalle import decode_codes, prefill_codes
 from dalle_pytorch_tpu.serve import (LATENCY, THROUGHPUT, GenerationServer,
-                                     SlotArena)
+                                     ServerStopped, SlotArena)
 from dalle_pytorch_tpu.utils import faults
 
 VCFG = VAEConfig(image_size=16, num_tokens=32, codebook_dim=16, num_layers=2,
@@ -254,6 +254,84 @@ def test_arena_geometry_and_cache_dtype(small):
         assert k.shape == (4, cfg.heads, cfg.seq_len, cfg.dim_head)
         assert k.dtype == jnp.bfloat16  # kv_cache_bf16 default ON
         assert v.dtype == jnp.bfloat16
+
+
+# --- shutdown/stop: the no-hung-future contract (ISSUE 12) ----------------
+
+
+def test_stop_fails_queued_and_running_futures_typed(small):
+    """The shutdown bugfix: stopping a server with requests queued AND
+    mid-decode fails every future with the typed ServerStopped — a caller
+    blocked on result() gets an exception immediately, never a hang —
+    and later submits are refused with the same type."""
+    _, _, _, texts, _ = small
+    srv = make_server(small, num_slots=1)
+    hs = [srv.submit(texts[i]) for i in range(3)]
+    srv.step()  # admit h0; h1/h2 stay queued
+    srv.step()
+    unfinished = srv.stop()
+    assert {h.request_id for h in unfinished} == {h.request_id for h in hs}
+    for h in hs:
+        assert h.future.done()
+        assert isinstance(h.future.exception(), ServerStopped)
+        with pytest.raises(ServerStopped):
+            h.result(0)
+    assert not srv.busy
+    assert srv.stopped and len(srv.failed) == 3
+    with pytest.raises(ServerStopped):
+        srv.submit(texts[0])
+    assert srv.stop() == []  # idempotent
+
+
+def test_stop_idle_server_then_submit_refused(small):
+    _, _, _, texts, _ = small
+    srv = make_server(small, num_slots=2)
+    h = srv.submit(texts[0])
+    srv.run_until_idle(max_ticks=100)
+    assert srv.stop() == []  # nothing in flight: nothing failed
+    assert h.future.exception() is None  # completed work is untouched
+    with pytest.raises(ServerStopped):
+        srv.submit(texts[1])
+
+
+def test_evict_queued_migrates_backlog_but_running_finishes(small):
+    """The drain primitive: evict_queued fails ONLY the queued backlog
+    (typed), refuses new admissions, and the running slot finishes its
+    decode bit-exact — the finish-or-migrate split the fleet drain
+    protocol is built on."""
+    _, _, _, texts, refs = small
+    srv = make_server(small, num_slots=1)
+    hs = [srv.submit(texts[i]) for i in range(3)]
+    srv.step()  # admit h0 only
+    evicted = srv.evict_queued()
+    assert [h.request_id for h in evicted] == [hs[1].request_id,
+                                               hs[2].request_id]
+    for h in evicted:
+        assert isinstance(h.future.exception(), ServerStopped)
+    assert srv.draining and not srv.stopped
+    with pytest.raises(ServerStopped):
+        srv.submit(texts[3])
+    srv.run_until_idle(max_ticks=200)
+    np.testing.assert_array_equal(hs[0].result(0), refs[0])
+
+
+def test_backlog_feedback_signal(small):
+    """backlog(): the cheap per-decision router feedback — queued per SLO
+    class + running count, consistent with stats()['queue_depth']."""
+    _, _, _, texts, _ = small
+    srv = make_server(small, num_slots=1)
+    assert srv.backlog() == {"queued": {LATENCY: 0, THROUGHPUT: 0},
+                             "queued_total": 0, "running": 0}
+    srv.submit(texts[0])
+    srv.submit(texts[1], slo=LATENCY)
+    srv.submit(texts[2])
+    srv.step(tick=False)  # admit one (latency first)
+    b = srv.backlog()
+    assert b["running"] == 1
+    assert b["queued"] == {LATENCY: 0, THROUGHPUT: 2}
+    assert b["queued_total"] == 2
+    assert srv.stats()["queue_depth"] == b["queued"]
+    srv.run_until_idle(max_ticks=300)
 
 
 # --- int8 quantized serving (ISSUE 7) -------------------------------------

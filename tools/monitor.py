@@ -43,6 +43,15 @@ already in its stream, and re-runs the declarative rules
 before a death still surfaces.  Exit 1 when any lane has active alerts
 or a stale stream, 2 when nothing is readable.
 
+With ``--metrics URL ...`` the fleet scan also scrapes each ``/metrics``
+endpoint (a serve fleet's ``obs_metrics.serve`` port) and prints one
+line per replica: lifecycle state (the one-hot
+``graft_replica_state{replica,state}`` gauges the serve tier exports),
+queue depth per SLO class, and slot occupancy — the live half of
+``obs_report --merge``'s after-the-fact fleet view.  An unreachable
+endpoint counts as a failed scan (exit 1); a DEAD replica is
+informational (a rolled replica is supposed to be dead).
+
 Usage:
     python tools/monitor.py HEARTBEAT_DIR [--timeout 300] [--expect N] [--watch S]
     python tools/monitor.py hb --watch 60 --ckpt-dir checkpoints \
@@ -182,9 +191,83 @@ def scan(directory: Path, timeout: float, expect: int | None,
     return int(ExitCode.MONITOR_STALLED) if bad else int(ExitCode.CLEAN)
 
 
-def fleet_scan(dirs: list[Path], timeout: float, window: float = 300.0
-               ) -> int:
-    """One fleet-mode scan over N telemetry dirs: align, tail, alert."""
+_METRIC_LINE_RE = re.compile(r"^(\w+)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _scrape_replica_metrics(url: str, timeout: float = 3.0
+                            ) -> dict[str, dict]:
+    """GET an endpoint's /metrics and fold the per-replica serve series
+    into ``{replica: {state, queue: {slo: depth}, occupancy}}``.  Only
+    replica-labeled series participate (a single-server trainer's
+    unlabeled gauges are not a fleet)."""
+    import urllib.request
+
+    target = url if "://" in url else f"http://{url}"
+    if not target.rstrip("/").endswith("/metrics"):
+        target = target.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(target, timeout=timeout) as resp:
+        text = resp.read().decode("utf-8", "replace")
+    out: dict[str, dict] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        m = _METRIC_LINE_RE.match(line)
+        if not m:
+            continue
+        name, labelstr, value = m.groups()
+        labels = dict(_LABEL_RE.findall(labelstr or ""))
+        rep = labels.get("replica")
+        if rep is None:
+            continue
+        info = out.setdefault(rep, {"queue": {}})
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        if name == "graft_replica_state" and v == 1.0:
+            info["state"] = labels.get("state", "?")
+        elif name == "graft_serve_queue_depth":
+            info["queue"][labels.get("slo", "?")] = v
+        elif name == "graft_serve_occupancy":
+            info["occupancy"] = v
+    return out
+
+
+def _print_replica_metrics(urls: list[str]) -> int:
+    """The per-replica serve-state lines of a fleet scan; returns the
+    number of UNREACHABLE endpoints (scrape failures, not dead replicas)."""
+    bad = 0
+    for url in urls:
+        try:
+            reps = _scrape_replica_metrics(url)
+        except OSError as e:
+            print(f"metrics {url}: unreachable ({e})", file=sys.stderr)
+            bad += 1
+            continue
+        if not reps:
+            print(f"metrics {url}: no replica-labeled serve series")
+            continue
+        for name in sorted(reps):
+            info = reps[name]
+            state = info.get("state", "?")
+            bits = [f"state {state}"]
+            if info["queue"]:
+                bits.append("queue " + ",".join(
+                    f"{slo}={int(d)}"
+                    for slo, d in sorted(info["queue"].items())))
+            if info.get("occupancy") is not None:
+                bits.append(f"occupancy {info['occupancy']:.2f}")
+            flag = "  << DOWN" if state == "dead" else ""
+            print(f"replica {name} [{url}]: {' '.join(bits)}{flag}")
+    return bad
+
+
+def fleet_scan(dirs: list[Path], timeout: float, window: float = 300.0,
+               metrics_urls: list[str] | None = None) -> int:
+    """One fleet-mode scan over N telemetry dirs: align, tail, alert —
+    plus the live per-replica serve state when ``metrics_urls`` name
+    scrapeable endpoints."""
     import time as _time
 
     from dalle_pytorch_tpu.obs import merge_streams
@@ -232,6 +315,8 @@ def fleet_scan(dirs: list[Path], timeout: float, window: float = 300.0
         if recent_alerts:
             print(f"  ALERTS: {', '.join(recent_alerts)}")
         bad += stale or bool(recent_alerts)
+    if metrics_urls:
+        bad += _print_replica_metrics(metrics_urls)
     return int(ExitCode.MONITOR_STALLED) if bad else int(ExitCode.CLEAN)
 
 
@@ -244,6 +329,13 @@ def main(argv=None) -> int:
                              "host) instead of heartbeat files — aligned "
                              "clock offsets, last-event ages, active "
                              "alerts per host")
+    parser.add_argument("--metrics", nargs="+", type=str, default=None,
+                        metavar="URL",
+                        help="fleet mode add-on: scrape each /metrics "
+                             "endpoint and print per-replica serve state "
+                             "(lifecycle, queue depth per SLO class, "
+                             "occupancy); an unreachable endpoint counts "
+                             "as a failed scan")
     parser.add_argument("--timeout", type=float, default=300,
                         help="seconds without a beat before a host counts as "
                              "stalled (default 300)")
@@ -289,7 +381,8 @@ def main(argv=None) -> int:
         code = int(ExitCode.MONITOR_NO_HEARTBEATS)
         try:
             while True:
-                code = fleet_scan(args.fleet, args.timeout)
+                code = fleet_scan(args.fleet, args.timeout,
+                                  metrics_urls=args.metrics)
                 if not args.watch:
                     return code
                 time.sleep(args.watch)
