@@ -34,6 +34,21 @@ FIRST_STEPS = 15  # until a success lands, run fewer scan steps: minutes to JSON
 ATTEMPT_TIMEOUT_DEFAULT = 300.0  # shared by the retry loop, stages, and meta
 
 
+def ledger_keys(cfg, *, target, plan, batch, **extra):
+    """The perf-ledger join keys for one measured point: hash the SAME
+    payload tools/graftprof.py hashes for its predicted row at this
+    (config, target, plan, batch), so a real-chip measurement lands
+    beside its roofline prediction in PERF_LEDGER.json (a point with no
+    prediction still lands, as a measured-only stub).  Spread the result
+    into a ``record_history`` record."""
+    from dalle_pytorch_tpu.obs import prof
+
+    payload = prof.fingerprint_payload(cfg, target=target, plan=plan,
+                                       batch=batch, **extra)
+    return {"ledger_fingerprint": prof.row_fingerprint(payload),
+            "ledger_target": target}
+
+
 def record_history(record):
     """Self-record one measurement: a ``bench`` event into the graftscope
     stream (always — CPU dev runs included, marked by their device kind)
@@ -44,14 +59,28 @@ def record_history(record):
     BENCH_TELEMETRY_DIR (or run under a trainer-installed telemetry).
     Every successful real-chip measurement leaves a committable trace next
     to the loss artifacts, so numbers taken between sessions (e.g. the
-    driver's end-of-round run) aren't lost when the tunnel dies again."""
-    from dalle_pytorch_tpu.obs import telemetry
+    driver's end-of-round run) aren't lost when the tunnel dies again.
+
+    Records carrying ``ledger_keys(...)`` additionally append a measured
+    row to PERF_LEDGER.json under the prediction's fingerprint —
+    real-chip runs only, unless GRAFT_PERF_LEDGER redirects the ledger
+    (CPU smoke tests exercise the join against a scratch file)."""
+    from dalle_pytorch_tpu.obs import prof, telemetry
 
     try:
         line = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
                 "device": jax.devices()[0].device_kind,
                 **record}
         telemetry.emit("bench", str(record.get("metric", "bench")), **line)
+        if record.get("ledger_fingerprint") and (
+                jax.devices()[0].platform != "cpu"
+                # graftlint: disable=ENV001 (path-valued var: set at all arms a scratch ledger)
+                or os.environ.get("GRAFT_PERF_LEDGER")):
+            prof.append_measured(
+                {k: record[k] for k in ("metric", "value", "unit",
+                                        "mfu", "tflops") if k in record},
+                fingerprint=record["ledger_fingerprint"],
+                target=record.get("ledger_target", ""))
         if jax.devices()[0].platform == "cpu":
             return  # CPU runs (tests, dev smoke) are not chip evidence
         # graftlint: disable=ENV001 (path-valued var: empty/unset mean default)
@@ -724,7 +753,9 @@ def main():
     # graftscope stream + the committable real-chip history line
     record_history({"tflops": round(flops / 1e12, 2),
                     "mfu": round(flops / device_peak_flops(), 4),
-                    **payload})
+                    **payload,
+                    **ledger_keys(cfg, target="dalle/dp", plan="dp",
+                                  batch=batch)})
     # informational stages (stderr only), each under the hang watchdog.
     # The process-wide wedge registry serializes them against each other
     # AND against any timed-out-but-alive measurement attempt: a wedged
@@ -771,7 +802,7 @@ def main():
         int(b) for b in
         os.environ.get("BENCH_GEN_BATCHES", "8,64").split(",") if b.strip())
     for gen_batch in gen_batches:
-        compile_fn, _ = make_gen_measure_deferred(batch=gen_batch)
+        compile_fn, gen_cfg = make_gen_measure_deferred(batch=gen_batch)
         gen_measure = bounded_stage(
             f"generation-b{gen_batch}-compile", compile_fn,
             lambda _: f"generation sampler (batch {gen_batch}) compiled",
@@ -789,7 +820,9 @@ def main():
                     "metric": "dalle_cub200_gen_throughput",
                     "value": round(gen_result[0], 1),
                     "unit": "image_tokens/sec",
-                    "meta": {"batch": gen_batch, "image_only_head": True}})
+                    "meta": {"batch": gen_batch, "image_only_head": True},
+                    **ledger_keys(gen_cfg, target="decode", plan="single",
+                                  batch=gen_batch)})
     from dalle_pytorch_tpu.utils.helpers import env_flag
 
     if env_flag("BENCH_VAE"):  # opt-in stage-1 number (BASELINE cfg 1)
@@ -800,7 +833,9 @@ def main():
             record_history({"metric": "vae128_train_throughput",
                             "value": round(vae_result[0], 2),
                             "unit": "images/sec",
-                            "meta": {"batch": 8}})
+                            "meta": {"batch": 8},
+                            **ledger_keys(vae128_config(), target="vae",
+                                          plan="single", batch=8)})
     if env_flag("BENCH_INGEST"):
         # opt-in host-only ingest stage: synthetic corpus -> folder vs
         # shards img/s + stall fraction.  No device work at all — this is
@@ -863,7 +898,10 @@ def main():
                     "value": round(serve_result[0], 1),
                     "unit": "image_tokens/sec",
                     "meta": {"slots": serve_slots, "open_loop": True,
-                             "oversubscribe": 1.25}})
+                             "oversubscribe": 1.25},
+                    **ledger_keys(cub200_config(), target="serve-tick",
+                                  plan="single", batch=serve_slots,
+                                  num_slots=serve_slots)})
     obs.shutdown()  # flush/close the bench-armed stream (no-op when off)
 
 

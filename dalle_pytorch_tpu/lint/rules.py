@@ -441,6 +441,42 @@ def rule_obs002(ctx: FileCtx) -> Iterator[RuleHit]:
                 yield node, msg
 
 
+# --- OBS003: unmanaged jax.profiler entry points ---------------------------
+
+_OBS3_PROFILER_CALLS = frozenset(("profiler.start_trace",
+                                  "profiler.stop_trace", "profiler.trace"))
+_OBS3_EXEMPT = (("obs", "prof.py"),)
+
+
+def rule_obs003(ctx: FileCtx) -> Iterator[RuleHit]:
+    """A direct ``jax.profiler.start_trace/stop_trace/trace`` call outside
+    ``obs/prof.py``'s managed ``capture()`` helper produces an on-chip
+    trace window the telemetry stream never hears about: the Perfetto
+    fleet merge can't correlate it, a death inside it leaves the profiler
+    wedged with no torn-span record, and graftscope's run report shows a
+    step-time crater with no cause.  Route captures through
+    ``obs.prof.capture(logdir)`` (or ``prof.XprofWindow`` for step-window
+    arming) — one entry point that opens the trace inside a ``prof.xprof``
+    span; pragma with a reason where a raw call is genuinely correct
+    (e.g. a debugging scratch script)."""
+    msg = ("direct jax.profiler trace call outside obs/prof.py: the "
+           "on-chip capture window never lands in the telemetry stream "
+           "(no prof.xprof span, no fleet correlation, no torn-span "
+           "record on death); use dalle_pytorch_tpu.obs.prof.capture / "
+           "XprofWindow, or pragma with why an unmanaged trace is "
+           "correct here")
+    parts = tuple(ctx.path.replace("\\", "/").split("/"))
+    if any(parts[-len(ex):] == ex for ex in _OBS3_EXEMPT):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if any(chain == c or chain.endswith("." + c)
+               for c in _OBS3_PROFILER_CALLS):
+            yield node, msg
+
+
 # --- SRV001: unbounded blocking waits in serve/ ---------------------------
 
 _SRV_BLOCKING = frozenset(("result", "get", "acquire"))
@@ -724,6 +760,7 @@ RULES = {
     "CKPT001": rule_ckpt001,
     "OBS001": rule_obs001,
     "OBS002": rule_obs002,
+    "OBS003": rule_obs003,
     "SRV001": rule_srv001,
     "DON001": rule_don001,
     "DON002": rule_don002,

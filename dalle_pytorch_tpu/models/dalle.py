@@ -34,6 +34,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..obs import prof
 from ..ops.transformer import Transformer
 from ..utils.helpers import max_neg_value, top_k_filter, top_p_filter
 
@@ -400,14 +401,15 @@ class DALLE(nn.Module):
         method so the pipeline-parallel trainer can run embeddings outside
         the pipelined stack (training.py::make_dalle_pp_train_step)."""
         cfg = self.cfg
-        tokens = self._embed_text(text, onehot)
-        if image_codes is not None and image_codes.shape[1] > 0:
-            image_emb = self._embed_image_codes(image_codes, onehot)
-            tokens = jnp.concatenate([tokens, image_emb], axis=1)
-        # drop the final token when the sequence overflows (ref :473-475)
-        if tokens.shape[1] > cfg.seq_len:
-            tokens = tokens[:, : cfg.seq_len]
-        return tokens
+        with prof.scope("embed"):
+            tokens = self._embed_text(text, onehot)
+            if image_codes is not None and image_codes.shape[1] > 0:
+                image_emb = self._embed_image_codes(image_codes, onehot)
+                tokens = jnp.concatenate([tokens, image_emb], axis=1)
+            # drop the final token when the sequence overflows (ref :473-475)
+            if tokens.shape[1] > cfg.seq_len:
+                tokens = tokens[:, : cfg.seq_len]
+            return tokens
 
     def _head(self, out, image_only: bool = False, text_only: bool = False,
               qhead=None):
@@ -417,13 +419,14 @@ class DALLE(nn.Module):
         image-phase kernel ``(int8, scale, bias)``: the head matmul then
         runs the int8 kernel as a direct multiplicand (f32 accumulation),
         bypassing — and letting jit prune — the f32 PhaseLogits params."""
-        h = self.final_norm(out.astype(jnp.float32))
-        if qhead is not None:
-            assert image_only, "quantized head is the decode (image) phase"
-            from ..ops.quant import qdense
-            return qdense(h, *qhead)  # f32 logits
-        return self.to_logits_dense(h, image_only=image_only,
-                                    text_only=text_only)
+        with prof.scope("logits-head"):
+            h = self.final_norm(out.astype(jnp.float32))
+            if qhead is not None:
+                assert image_only, "quantized head is the decode (image) phase"
+                from ..ops.quant import qdense
+                return qdense(h, *qhead)  # f32 logits
+            return self.to_logits_dense(h, image_only=image_only,
+                                        text_only=text_only)
 
     @staticmethod
     def _phase_nll(phase_logits, labels):
@@ -459,10 +462,11 @@ class DALLE(nn.Module):
             V_text = cfg.total_text_tokens
             text_logits = logits[:, :T, :V_text]
             img_logits = logits[:, T:, V_text:]
-        loss_text = self._phase_nll(text_logits,
-                                    self._remap_pad_tokens(text)).mean()
-        loss_img = self._phase_nll(img_logits, image_codes).mean()
-        return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)
+        with prof.scope("logits-head"):
+            loss_text = self._phase_nll(text_logits,
+                                        self._remap_pad_tokens(text)).mean()
+            loss_img = self._phase_nll(img_logits, image_codes).mean()
+            return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)
 
     def _sp_loss(self, text, image_codes, onehot: bool, deterministic: bool):
         """Sequence-parallel training loss — runs INSIDE a shard_map over
@@ -500,13 +504,16 @@ class DALLE(nn.Module):
                              self._phase_nll(phase_logits, labels), 0.0).sum()
 
         b = text.shape[0]
-        sum_t = jax.lax.psum(
-            phase_ce_sum(logits[..., :V_text], lab_t, is_text), cfg.ring_axis)
-        sum_i = jax.lax.psum(
-            phase_ce_sum(logits[..., V_text:], lab_i, ~is_text), cfg.ring_axis)
-        loss_text = sum_t / (b * T)
-        loss_img = sum_i / (b * cfg.image_seq_len)
-        return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)
+        with prof.scope("logits-head"):
+            sum_t = jax.lax.psum(
+                phase_ce_sum(logits[..., :V_text], lab_t, is_text),
+                cfg.ring_axis)
+            sum_i = jax.lax.psum(
+                phase_ce_sum(logits[..., V_text:], lab_i, ~is_text),
+                cfg.ring_axis)
+            loss_text = sum_t / (b * T)
+            loss_img = sum_i / (b * cfg.image_seq_len)
+            return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)
 
     def __call__(self, text, image_codes=None, mask=None, return_loss: bool = False,
                  deterministic: bool = True):
@@ -544,15 +551,17 @@ class DALLE(nn.Module):
         the full static seq_len, returning (last-position image-phase
         logits [b, num_image_tokens], caches)."""
         cfg = self.cfg
-        tokens = self._embed_text(text)
-        n_pre = tokens.shape[1]
-        if prime_codes is not None and prime_codes.shape[1] > 0:
-            tokens = jnp.concatenate(
-                [tokens, self._embed_image_codes(prime_codes)], axis=1)
+        with prof.scope("embed"):
+            tokens = self._embed_text(text)
             n_pre = tokens.shape[1]
-        pad = cfg.seq_len - tokens.shape[1]
-        assert pad >= 0, "priming must leave at least one image token to sample"
-        tokens = jnp.pad(tokens, ((0, 0), (0, pad), (0, 0)))
+            if prime_codes is not None and prime_codes.shape[1] > 0:
+                tokens = jnp.concatenate(
+                    [tokens, self._embed_image_codes(prime_codes)], axis=1)
+                n_pre = tokens.shape[1]
+            pad = cfg.seq_len - tokens.shape[1]
+            assert pad >= 0, ("priming must leave at least one image token "
+                              "to sample")
+            tokens = jnp.pad(tokens, ((0, 0), (0, pad), (0, 0)))
 
         out, kvs = self.transformer(tokens, mask=self._pad_mask_for_bos(mask),
                                     return_kv=True)
@@ -562,14 +571,16 @@ class DALLE(nn.Module):
             # in hand — then frozen for the decode writes (ops/quant.py
             # scale-layout contract).  Takes precedence over kv_cache_bf16.
             from ..ops.quant import quantize_per_head
-            kvs = [(quantize_per_head(k), quantize_per_head(v))
-                   for k, v in kvs]
+            with prof.scope("attn-cache"):
+                kvs = [(quantize_per_head(k), quantize_per_head(v))
+                       for k, v in kvs]
         elif cfg.kv_cache_bf16:
             # cache STORAGE dtype only: the decode step re-reads these
             # through f32-accumulating dots (ops/attention.py::decode_step),
             # so this is a pure byte cut on the HBM-bound decode loop
-            kvs = [(k.astype(jnp.bfloat16), v.astype(jnp.bfloat16))
-                   for k, v in kvs]
+            with prof.scope("attn-cache"):
+                kvs = [(k.astype(jnp.bfloat16), v.astype(jnp.bfloat16))
+                       for k, v in kvs]
         last = out[:, n_pre - 1 : n_pre]
         logits = self._head(last, image_only=True)
         return logits[:, 0], kvs
@@ -593,26 +604,29 @@ class DALLE(nn.Module):
         projections and the image head then run int8 multiplicands with
         f32 accumulation instead of streaming the f32 params."""
         cfg = self.cfg
-        emb = self.image_emb(code[:, None])
-        img_index = index - (cfg.text_seq_len + 1)
-        pos_grid = self.image_pos_emb(cfg.image_seq_len)
-        if jnp.ndim(index) > 0:
-            # per-row positions: gather each row's pos-emb (clipped like
-            # dynamic_slice clamps — idle serve slots park out of range)
-            rows = jnp.clip(img_index, 0, cfg.image_seq_len - 1)
-            emb = emb + jnp.take(pos_grid, rows, axis=0)[:, None]
-        else:
-            emb = emb + jax.lax.dynamic_slice_in_dim(
-                pos_grid, img_index, 1, axis=0)[None]
-        x = emb.astype(cfg.dtype)
-        out, caches = self.transformer.decode_step(
-            x, caches, index, mask=self._pad_mask_for_bos(mask),
-            write_pos=write_pos,
-            qweights=None if qweights is None else qweights["layers"])
-        logits = self._head(out, image_only=True,
-                            qhead=None if qweights is None
-                            else qweights["head"])
-        return logits[:, 0], caches
+        with prof.scope("decode-step"):
+            with prof.scope("embed"):
+                emb = self.image_emb(code[:, None])
+                img_index = index - (cfg.text_seq_len + 1)
+                pos_grid = self.image_pos_emb(cfg.image_seq_len)
+                if jnp.ndim(index) > 0:
+                    # per-row positions: gather each row's pos-emb (clipped
+                    # like dynamic_slice clamps — idle serve slots park out
+                    # of range)
+                    rows = jnp.clip(img_index, 0, cfg.image_seq_len - 1)
+                    emb = emb + jnp.take(pos_grid, rows, axis=0)[:, None]
+                else:
+                    emb = emb + jax.lax.dynamic_slice_in_dim(
+                        pos_grid, img_index, 1, axis=0)[None]
+                x = emb.astype(cfg.dtype)
+            out, caches = self.transformer.decode_step(
+                x, caches, index, mask=self._pad_mask_for_bos(mask),
+                write_pos=write_pos,
+                qweights=None if qweights is None else qweights["layers"])
+            logits = self._head(out, image_only=True,
+                                qhead=None if qweights is None
+                                else qweights["head"])
+            return logits[:, 0], caches
 
 
 def quantize_decode_weights(params, cfg: DALLEConfig):
@@ -726,18 +740,11 @@ def decode_codes(dalle: DALLE, params, first_logits, caches, rng, *,
     """
     cfg = dalle.cfg
     n_pre = cfg.text_seq_len + 1 + n_prime
-    # weights_int8: quantize once per call — a scan constant, so XLA
-    # hoists it and the decode loop streams only the int8 copies
-    qweights = (quantize_decode_weights(params, cfg)
-                if cfg.weights_int8 else None)
 
     def sample(logits, key):
         return sample_image_code(logits, key, k_vocab=cfg.total_tokens,
                                  filter_thres=filter_thres,
                                  temperature=temperature, top_p=top_p)
-
-    rng, key0 = jax.random.split(rng)
-    first_code = sample(first_logits, key0)
 
     def step(carry, key):
         code, caches, index = carry
@@ -747,16 +754,25 @@ def decode_codes(dalle: DALLE, params, first_logits, caches, rng, *,
         next_code = sample(logits, key)
         return (next_code, caches, index + 1), next_code
 
-    num_steps = cfg.seq_len - n_pre  # remaining image positions
-    keys = jax.random.split(rng, num_steps) if num_steps > 0 else jnp.zeros((0, 2), jnp.uint32)
-    (_, _, _), rest = jax.lax.scan(
-        step, (first_code, caches, jnp.asarray(n_pre)), keys)
-    rest = rest.transpose(1, 0)  # [b, num_steps]
+    with prof.scope("decode-step"):
+        # weights_int8: quantize once per call — a scan constant, so XLA
+        # hoists it and the decode loop streams only the int8 copies
+        qweights = (quantize_decode_weights(params, cfg)
+                    if cfg.weights_int8 else None)
+        rng, key0 = jax.random.split(rng)
+        first_code = sample(first_logits, key0)
 
-    parts = [first_code[:, None], rest]
-    if prime_codes is not None and n_prime > 0:
-        parts.insert(0, prime_codes)
-    return jnp.concatenate(parts, axis=1)
+        num_steps = cfg.seq_len - n_pre  # remaining image positions
+        keys = (jax.random.split(rng, num_steps) if num_steps > 0
+                else jnp.zeros((0, 2), jnp.uint32))
+        (_, _, _), rest = jax.lax.scan(
+            step, (first_code, caches, jnp.asarray(n_pre)), keys)
+        rest = rest.transpose(1, 0)  # [b, num_steps]
+
+        parts = [first_code[:, None], rest]
+        if prime_codes is not None and n_prime > 0:
+            parts.insert(0, prime_codes)
+        return jnp.concatenate(parts, axis=1)
 
 
 def generate_codes(dalle: DALLE, params, text, rng, *, prime_codes=None,

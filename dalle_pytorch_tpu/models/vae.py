@@ -31,6 +31,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..obs import prof
 from ..utils.helpers import default
 
 
@@ -105,15 +106,17 @@ class Encoder(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        for _ in range(cfg.num_layers):
-            x = nn.Conv(cfg.hidden_dim, (4, 4), strides=2, padding=1, dtype=cfg.dtype)(x)
-            x = nn.relu(x)
-        for _ in range(cfg.num_resnet_blocks):
-            x = ResBlock(cfg.hidden_dim, dtype=cfg.dtype)(x)
-        # 1x1 conv head to codebook logits; keep the head in f32 for a stable
-        # gumbel-softmax even when the trunk runs in bf16.
-        x = nn.Conv(cfg.num_tokens, (1, 1), dtype=jnp.float32)(x)
-        return x  # [b, h, w, num_tokens]
+        with prof.scope("vae-conv"):
+            for _ in range(cfg.num_layers):
+                x = nn.Conv(cfg.hidden_dim, (4, 4), strides=2, padding=1,
+                            dtype=cfg.dtype)(x)
+                x = nn.relu(x)
+            for _ in range(cfg.num_resnet_blocks):
+                x = ResBlock(cfg.hidden_dim, dtype=cfg.dtype)(x)
+            # 1x1 conv head to codebook logits; keep the head in f32 for a
+            # stable gumbel-softmax even when the trunk runs in bf16.
+            x = nn.Conv(cfg.num_tokens, (1, 1), dtype=jnp.float32)(x)
+            return x  # [b, h, w, num_tokens]
 
 
 class Decoder(nn.Module):
@@ -123,15 +126,17 @@ class Decoder(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         has_resblocks = cfg.num_resnet_blocks > 0
-        if has_resblocks:
-            x = nn.Conv(cfg.hidden_dim, (1, 1), dtype=cfg.dtype)(x)
-            for _ in range(cfg.num_resnet_blocks):
-                x = ResBlock(cfg.hidden_dim, dtype=cfg.dtype)(x)
-        for _ in range(cfg.num_layers):
-            x = nn.ConvTranspose(cfg.hidden_dim, (4, 4), strides=(2, 2), padding="SAME", dtype=cfg.dtype)(x)
-            x = nn.relu(x)
-        x = nn.Conv(cfg.channels, (1, 1), dtype=jnp.float32)(x)
-        return x  # [b, H, W, channels]
+        with prof.scope("vae-conv"):
+            if has_resblocks:
+                x = nn.Conv(cfg.hidden_dim, (1, 1), dtype=cfg.dtype)(x)
+                for _ in range(cfg.num_resnet_blocks):
+                    x = ResBlock(cfg.hidden_dim, dtype=cfg.dtype)(x)
+            for _ in range(cfg.num_layers):
+                x = nn.ConvTranspose(cfg.hidden_dim, (4, 4), strides=(2, 2),
+                                     padding="SAME", dtype=cfg.dtype)(x)
+                x = nn.relu(x)
+            x = nn.Conv(cfg.channels, (1, 1), dtype=jnp.float32)(x)
+            return x  # [b, H, W, channels]
 
 
 def gumbel_softmax(logits, key, tau, hard, axis=-1):
@@ -184,7 +189,9 @@ class DiscreteVAE(nn.Module):
         """Token ids [b, n] -> images [b, H, W, c] (ref :151-161)."""
         b, n = img_seq.shape
         h = w = int(math.isqrt(n))
-        embeds = self.codebook(img_seq).reshape(b, h, w, self.cfg.codebook_dim)
+        with prof.scope("vae-codebook"):
+            embeds = self.codebook(img_seq).reshape(b, h, w,
+                                                    self.cfg.codebook_dim)
         return self.decoder(embeds.astype(self.cfg.dtype))
 
     def __call__(self, img, *, rng=None, return_loss=False, return_recons=False,
@@ -201,34 +208,39 @@ class DiscreteVAE(nn.Module):
         temp = default(temp, cfg.temperature)
         if rng is None:
             rng = self.make_rng("gumbel")
-        soft_one_hot = gumbel_softmax(logits, rng, tau=temp, hard=cfg.straight_through)
-        # [b,h,w,n] @ [n,d] -> [b,h,w,d]; large matmul, lands on the MXU.
-        sampled = jnp.einsum(
-            "bhwn,nd->bhwd", soft_one_hot,
-            self.codebook.embedding.astype(soft_one_hot.dtype),
-            preferred_element_type=jnp.float32,
-        )
+        with prof.scope("vae-codebook"):
+            soft_one_hot = gumbel_softmax(logits, rng, tau=temp,
+                                          hard=cfg.straight_through)
+            # [b,h,w,n] @ [n,d] -> [b,h,w,d]; large matmul, lands on the MXU.
+            sampled = jnp.einsum(
+                "bhwn,nd->bhwd", soft_one_hot,
+                self.codebook.embedding.astype(soft_one_hot.dtype),
+                preferred_element_type=jnp.float32,
+            )
         out = self.decoder(sampled.astype(cfg.dtype))
 
         if not return_loss:
             return out
 
-        target = self.norm(img).astype(jnp.float32)
-        out_f32 = out.astype(jnp.float32)
-        if cfg.smooth_l1_loss:
-            diff = jnp.abs(out_f32 - target)
-            recon_loss = jnp.where(diff < 1.0, 0.5 * diff ** 2, diff - 0.5).mean()
-        else:
-            recon_loss = ((out_f32 - target) ** 2).mean()
+        with prof.scope("vae-loss"):
+            target = self.norm(img).astype(jnp.float32)
+            out_f32 = out.astype(jnp.float32)
+            if cfg.smooth_l1_loss:
+                diff = jnp.abs(out_f32 - target)
+                recon_loss = jnp.where(diff < 1.0, 0.5 * diff ** 2,
+                                       diff - 0.5).mean()
+            else:
+                recon_loss = ((out_f32 - target) ** 2).mean()
 
-        # KL(q || uniform), torch-'batchmean' reduction (ref :193-198).
-        b = logits.shape[0]
-        logits_flat = logits.reshape(b, -1, cfg.num_tokens).astype(jnp.float32)
-        log_qy = jax.nn.log_softmax(logits_flat, axis=-1)
-        log_uniform = -jnp.log(float(cfg.num_tokens))
-        kl_div = (jnp.exp(log_qy) * (log_qy - log_uniform)).sum() / b
+            # KL(q || uniform), torch-'batchmean' reduction (ref :193-198).
+            b = logits.shape[0]
+            logits_flat = logits.reshape(b, -1,
+                                         cfg.num_tokens).astype(jnp.float32)
+            log_qy = jax.nn.log_softmax(logits_flat, axis=-1)
+            log_uniform = -jnp.log(float(cfg.num_tokens))
+            kl_div = (jnp.exp(log_qy) * (log_qy - log_uniform)).sum() / b
 
-        loss = recon_loss + kl_div * cfg.kl_div_loss_weight
+            loss = recon_loss + kl_div * cfg.kl_div_loss_weight
         if not return_recons:
             return loss
         return loss, out

@@ -15,6 +15,12 @@ stream itself:
   run's own median so far (``mfu_drop``: a straggler or a thermally
   throttled chip reads as "slower than this very run used to be", no
   absolute threshold needed).
+* **ratio_of_ref** — the windowed mean falls below a fraction of a
+  reference value another record announced (``mfu_vs_predicted``: the
+  trainer emits the roofline-predicted MFU ceiling from the perf ledger
+  at run start; measured MFU sustained under half the *predicted*
+  ceiling is a sick run even on its very first window — the
+  ratio_of_median rule is blind to a run that was born slow).
 * **rate** — more than N matching events inside the window
   (``quarantine_rate``: the data diet is rotting faster than the
   per-sample policy can hide).
@@ -47,9 +53,11 @@ class Rule:
     sampled (None counts 1.0 per match; bools coerce to 0/1).  ``kind``
     picks the evaluation: threshold (window mean ``op`` ``limit``),
     ratio_of_median (window mean < ``ratio`` x run median),
-    rate (window count > ``limit``), gap (mono gap > ``limit``).
-    ``cooldown_s`` bounds re-firing so a sustained condition is one alert
-    per cooldown, not one per record."""
+    ratio_of_ref (window mean < ``ratio`` x the reference the
+    ``ref_kind``/``ref_name`` record announced in ``ref_field`` —
+    silent until that record arrives), rate (window count > ``limit``),
+    gap (mono gap > ``limit``).  ``cooldown_s`` bounds re-firing so a
+    sustained condition is one alert per cooldown, not one per record."""
 
     name: str
     kind: str
@@ -63,6 +71,9 @@ class Rule:
     min_count: int = 3
     cooldown_s: float = 300.0
     describe: str = ""
+    ref_kind: Optional[str] = None
+    ref_name: Optional[str] = None
+    ref_field: Optional[str] = None
 
 
 DEFAULT_RULES: Tuple[Rule, ...] = (
@@ -73,6 +84,11 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
     Rule(name="mfu_drop", kind="ratio_of_median", select_kind="step",
          field="mfu", ratio=0.6, window_s=120.0, min_count=5,
          describe="MFU fell well below this run's own median"),
+    Rule(name="mfu_vs_predicted", kind="ratio_of_ref", select_kind="step",
+         field="mfu", ratio=0.5, window_s=120.0, min_count=5,
+         ref_kind="prof", ref_name="predicted", ref_field="mfu",
+         describe="measured MFU sustained under half the roofline "
+                  "ceiling the perf ledger predicts for this config"),
     Rule(name="slo_attainment", kind="threshold", select_kind="serve",
          select_names=("retire",), field="slo_ok", op="<", limit=0.9,
          window_s=120.0, min_count=10,
@@ -88,13 +104,15 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
 
 
 class _RuleState:
-    __slots__ = ("window", "history", "last_match_mono", "last_fire_mono")
+    __slots__ = ("window", "history", "last_match_mono", "last_fire_mono",
+                 "ref")
 
     def __init__(self):
         self.window: Deque[Tuple[float, float]] = deque()  # (mono, value)
         self.history: List[float] = []       # all-time samples (median)
         self.last_match_mono: Optional[float] = None
         self.last_fire_mono: Optional[float] = None
+        self.ref: Optional[float] = None     # ratio_of_ref reference value
 
 
 def _cmp(value: float, op: str, limit: float) -> bool:
@@ -136,6 +154,12 @@ class AlertEngine:
     def _observe_one(self, rule: Rule, rec: dict, kind: str,
                      mono: float) -> Optional[dict]:
         st = self._state[rule.name]
+        if rule.ref_kind is not None and kind == rule.ref_kind \
+                and (rule.ref_name is None
+                     or rec.get("name") == rule.ref_name):
+            raw_ref = rec.get(rule.ref_field)
+            if raw_ref is not None:
+                st.ref = float(raw_ref)
         matched = (kind == rule.select_kind
                    and (rule.select_names is None
                         or rec.get("name") in rule.select_names))
@@ -171,8 +195,9 @@ class AlertEngine:
         measured, msg = verdict
         return {
             "rule": rule.name, "value": round(measured, 6),
-            "limit": rule.limit if rule.kind != "ratio_of_median"
-            else rule.ratio,
+            "limit": rule.ratio if rule.kind in ("ratio_of_median",
+                                                 "ratio_of_ref")
+            else rule.limit,
             "window_s": rule.window_s, "window_n": len(st.window),
             "cause_seq": rec.get("seq"), "cause_kind": kind,
             "cause_name": rec.get("name"),
@@ -210,5 +235,14 @@ class AlertEngine:
             if median > 0 and mean < rule.ratio * median:
                 return mean, f"window mean {mean:.4g} < " \
                              f"{rule.ratio:g} x run median {median:.4g}"
+            return None
+        if rule.kind == "ratio_of_ref":
+            # silent until the reference record arrives (a run without a
+            # ledger prediction simply never evaluates this rule)
+            if st.ref is None or st.ref <= 0:
+                return None
+            if mean < rule.ratio * st.ref:
+                return mean, f"window mean {mean:.4g} < {rule.ratio:g} x " \
+                             f"reference {st.ref:.4g}"
             return None
         raise ValueError(f"unknown rule kind {rule.kind!r}")

@@ -228,6 +228,14 @@ class MetricsRegistry:
         elif kind == "alert":
             self.counter("graft_alerts_total", "alert rules fired",
                          rule=rec.get("name", "?")).inc()
+        elif kind == "prof" and rec.get("name") == "predicted":
+            # the roofline ceiling the perf ledger predicts for this
+            # config — scrape beside graft_step_mfu for the
+            # predicted-vs-measured panel
+            if rec.get("mfu") is not None:
+                self.gauge("graft_predicted_mfu",
+                           "roofline-predicted MFU ceiling "
+                           "(PERF_LEDGER.json)").set(float(rec["mfu"]))
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):

@@ -177,6 +177,32 @@ def build_report(events: List[dict]) -> dict:
         "by_class": per_class,
     }
 
+    # --- roofline: predicted vs measured ------------------------------------
+    # trainers emit one `prof.predicted` record at run start (the perf
+    # ledger's roofline ceiling for their config fingerprint); joined here
+    # with the StepTimer's measured MFU it answers "is this run as fast as
+    # this code CAN go" rather than "as fast as it used to go"
+    prof_rows = [r for r in events if r.get("kind") == "prof"
+                 and r.get("name") == "predicted" and "ph" not in r]
+    prof_report: Optional[dict] = None
+    if prof_rows:
+        p = prof_rows[-1]
+        measured = step_report.get("mfu_last")
+        predicted = p.get("mfu")
+        prof_report = {
+            "fingerprint": p.get("fingerprint"),
+            "exact": p.get("exact"),
+            "chip": p.get("chip"),
+            "predicted_mfu": predicted,
+            "pred_step_time_s": p.get("pred_step_time_s"),
+            "bound": p.get("bound"),
+            "measured_mfu": measured,
+            "measured_step_time_p50": step_report.get("step_time_p50"),
+            "attained_frac": (float(measured) / float(predicted)
+                              if measured is not None and predicted
+                              else None),
+        }
+
     # --- faults / data ------------------------------------------------------
     faults = [{"site": r.get("name"), "action": r.get("action"),
                "step": r.get("step"), "hits": r.get("hits"),
@@ -199,6 +225,7 @@ def build_report(events: List[dict]) -> dict:
         "health": health_report,
         "ckpt": ckpt_report,
         "serve": serve_report,
+        "prof": prof_report,
         "faults": faults,
         "data": data_report,
         "torn_spans": [{"kind": r.get("kind"), "name": r.get("name"),
@@ -350,6 +377,21 @@ def render_text(report: dict) -> str:
                 f"{_fmt(row['attainment'])}")
     else:
         lines.append("no serve events")
+
+    prof = report.get("prof")
+    if prof:
+        lines.append("-- roofline (predicted vs measured) --")
+        lines.append(
+            f"ledger {prof.get('fingerprint')} "
+            f"({'exact' if prof.get('exact') else 'plan-level'}, chip "
+            f"{prof.get('chip')}): predicted mfu "
+            f"{_fmt(prof.get('predicted_mfu'))} "
+            f"({prof.get('bound')}-bound, step "
+            f"{_fmt(prof.get('pred_step_time_s'))}s)")
+        lines.append(
+            f"measured: mfu {_fmt(prof.get('measured_mfu'))}, step_time p50 "
+            f"{_fmt(prof.get('measured_step_time_p50'))}s -> attained "
+            f"{_fmt(prof.get('attained_frac'))} of ceiling")
 
     if report["faults"]:
         lines.append("-- injected faults --")

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import prof
 from ..utils.helpers import max_neg_value
 from .quant import (cache_write, circular_slice_in_dim, qdense, scaled_qdot,
                     split_cache)
@@ -341,9 +342,10 @@ class MultiHeadAttention(nn.Module):
         self.drop = nn.Dropout(self.dropout)
 
     def _qkv(self, x):
-        qkv = self.to_qkv(x)  # [b, n, 3, heads, dh]
-        qkv = qkv.transpose(2, 0, 3, 1, 4)  # [3, b, heads, n, dh]
-        return qkv[0], qkv[1], qkv[2]
+        with prof.scope("attn-qkv"):
+            qkv = self.to_qkv(x)  # [b, n, 3, heads, dh]
+            qkv = qkv.transpose(2, 0, 3, 1, 4)  # [3, b, heads, n, dh]
+            return qkv[0], qkv[1], qkv[2]
 
     def _key_pad_bias(self, mask, n):
         """[b, m] bool key mask -> additive f32 [b, n] bias, same scoping as
@@ -375,9 +377,10 @@ class MultiHeadAttention(nn.Module):
                 from ..parallel.ulysses import ulysses_attention as sp_attn
             else:
                 from ..parallel.ring import ring_attention as sp_attn
-            out = sp_attn(q, k, v, axis_name=self.ring_axis,
-                          pattern=self.pattern,
-                          causal=self.pattern.causal)
+            with prof.scope("attn-scores"):
+                out = sp_attn(q, k, v, axis_name=self.ring_axis,
+                              pattern=self.pattern,
+                              causal=self.pattern.causal)
         elif self.use_pallas:
             from .attention_pallas import flash_pattern_attention
 
@@ -386,26 +389,29 @@ class MultiHeadAttention(nn.Module):
             assert self.pallas_block_q >= 1 and self.pallas_block_k >= 1, (
                 f"invalid Pallas block sizes {self.pallas_block_q}x"
                 f"{self.pallas_block_k}")
-            out = flash_pattern_attention(
-                q, k, v, self.pattern,
-                key_pad_bias=self._key_pad_bias(mask, n),
-                block_q=self.pallas_block_q, block_k=self.pallas_block_k,
-                interpret=jax.default_backend() != "tpu")
+            with prof.scope("attn-scores"):
+                out = flash_pattern_attention(
+                    q, k, v, self.pattern,
+                    key_pad_bias=self._key_pad_bias(mask, n),
+                    block_q=self.pallas_block_q, block_k=self.pallas_block_k,
+                    interpret=jax.default_backend() != "tpu")
         else:
-            scale = self.dim_head ** -0.5
-            dots = jnp.einsum("bhid,bhjd->bhij", q * scale, k,
-                              preferred_element_type=jnp.float32)
-            allow = jnp.asarray(dense_pattern_mask(self.pattern, n, n))[None, None]
-            allow = _merge_key_pad_mask(self.pattern, allow, mask)
-            dots = jnp.where(allow, dots, max_neg_value(dots.dtype))
-            attn = jax.nn.softmax(dots, axis=-1).astype(x.dtype)
-            # graftlint: disable=DOT001 (uniform: attn is cast to x.dtype above, matching v; parity pinned by tests/attention_refs)
-            out = jnp.einsum("bhij,bhjd->bhid", attn, v)
+            with prof.scope("attn-scores"):
+                scale = self.dim_head ** -0.5
+                dots = jnp.einsum("bhid,bhjd->bhij", q * scale, k,
+                                  preferred_element_type=jnp.float32)
+                allow = jnp.asarray(dense_pattern_mask(self.pattern, n, n))[None, None]
+                allow = _merge_key_pad_mask(self.pattern, allow, mask)
+                dots = jnp.where(allow, dots, max_neg_value(dots.dtype))
+                attn = jax.nn.softmax(dots, axis=-1).astype(x.dtype)
+                # graftlint: disable=DOT001 (uniform: attn is cast to x.dtype above, matching v; parity pinned by tests/attention_refs)
+                out = jnp.einsum("bhij,bhjd->bhid", attn, v)
 
-        out = out.astype(x.dtype)
-        out = out.transpose(0, 2, 1, 3).reshape(b, n, self.heads * self.dim_head)
-        out = self.to_out(out)
-        out = self.drop(out, deterministic=deterministic)
+        with prof.scope("attn-out"):
+            out = out.astype(x.dtype)
+            out = out.transpose(0, 2, 1, 3).reshape(b, n, self.heads * self.dim_head)
+            out = self.to_out(out)
+            out = self.drop(out, deterministic=deterministic)
         if return_kv:
             return out, (k, v)
         return out
@@ -417,16 +423,18 @@ class MultiHeadAttention(nn.Module):
         applied to the small product, never to the kernel)."""
         if qw is None:
             return self._qkv(x)
-        q8, s = qw["qkv"]                       # [dim, 3, h, dh] int8
-        qkv = qdense(x, q8, s).astype(self.dtype)
-        qkv = qkv.transpose(2, 0, 3, 1, 4)      # [3, b, heads, n, dh]
-        return qkv[0], qkv[1], qkv[2]
+        with prof.scope("attn-qkv"):
+            q8, s = qw["qkv"]                   # [dim, 3, h, dh] int8
+            qkv = qdense(x, q8, s).astype(self.dtype)
+            qkv = qkv.transpose(2, 0, 3, 1, 4)  # [3, b, heads, n, dh]
+            return qkv[0], qkv[1], qkv[2]
 
     def _out_proj(self, out, qw):
-        if qw is None:
-            return self.to_out(out)
-        q8, s, bias = qw["out"]
-        return qdense(out, q8, s, bias).astype(self.dtype)
+        with prof.scope("attn-out"):
+            if qw is None:
+                return self.to_out(out)
+            q8, s, bias = qw["out"]
+            return qdense(out, q8, s, bias).astype(self.dtype)
 
     def _cache_dots(self, q_scaled, k_sub, k_scale):
         """q·k over a cache read of either storage layout.  Plain caches
@@ -475,10 +483,11 @@ class MultiHeadAttention(nn.Module):
         if write_pos is not None:
             return self._decode_step_aligned(x, q, k, v, cache_k, cache_v,
                                              index, write_pos, mask, qw)
-        cache_k = cache_write(cache_k, k, (0, 0, index, 0))
-        cache_v = cache_write(cache_v, v, (0, 0, index, 0))
-        k_vals, k_scale = split_cache(cache_k)
-        v_vals, v_scale = split_cache(cache_v)
+        with prof.scope("attn-cache"):
+            cache_k = cache_write(cache_k, k, (0, 0, index, 0))
+            cache_v = cache_write(cache_v, v, (0, 0, index, 0))
+            k_vals, k_scale = split_cache(cache_k)
+            v_vals, v_scale = split_cache(cache_v)
         n_k = k_vals.shape[2]
         scale = self.dim_head ** -0.5
         sliced = (decode_key_positions(self.pattern, index)
@@ -517,36 +526,41 @@ class MultiHeadAttention(nn.Module):
                          jax.lax.dynamic_slice_in_dim(cache, start, m_img,
                                                       axis=2)], axis=2)
 
-                k_sub, v_sub = seg(k_vals), seg(v_vals)
+                with prof.scope("attn-cache"):
+                    k_sub, v_sub = seg(k_vals), seg(v_vals)
                 safe = positions  # all in [0, n_k) by the clamp above
             else:
                 valid = valid & (positions >= 0) & (positions < n_k)
                 safe = jnp.clip(positions, 0, n_k - 1)
-                k_sub = jnp.take(k_vals, safe, axis=2)  # [b, h, m, dh]
-                v_sub = jnp.take(v_vals, safe, axis=2)
-            dots = self._cache_dots(q * scale, k_sub, k_scale)
-            row = (_allowed(self.pattern, index, positions, jnp)
-                   & valid)[None, None, None, :]
-            if mask is not None:
-                pad = _scope_key_pad(self.pattern, mask, n_k)
-                row = row & jnp.take(pad, safe, axis=1)[:, None, None, :]
+                with prof.scope("attn-cache"):
+                    k_sub = jnp.take(k_vals, safe, axis=2)  # [b, h, m, dh]
+                    v_sub = jnp.take(v_vals, safe, axis=2)
+            with prof.scope("attn-scores"):
+                dots = self._cache_dots(q * scale, k_sub, k_scale)
+                row = (_allowed(self.pattern, index, positions, jnp)
+                       & valid)[None, None, None, :]
+                if mask is not None:
+                    pad = _scope_key_pad(self.pattern, mask, n_k)
+                    row = row & jnp.take(pad, safe, axis=1)[:, None, None, :]
+                dots = jnp.where(row, dots, max_neg_value(dots.dtype))
+                attn = jax.nn.softmax(dots, axis=-1)  # f32
+                out = self._attn_v(attn, v_sub, v_scale, x.dtype)
+                out = out.transpose(0, 2, 1, 3).reshape(
+                    b, 1, self.heads * self.dim_head)
+            return self._out_proj(out, qw), cache_k, cache_v
+        with prof.scope("attn-scores"):
+            dots = self._cache_dots(q * scale, k_vals, k_scale)
+            layout = self.pattern.block_layout()
+            row = pattern_mask_row(
+                self.pattern, index, n_k,
+                layout=jnp.asarray(layout) if layout is not None else None,
+            )[None, None, None, :]
+            row = _merge_key_pad_mask(self.pattern, row, mask)
             dots = jnp.where(row, dots, max_neg_value(dots.dtype))
             attn = jax.nn.softmax(dots, axis=-1)  # f32
-            out = self._attn_v(attn, v_sub, v_scale, x.dtype)
+            out = self._attn_v(attn, v_vals, v_scale, x.dtype)
             out = out.transpose(0, 2, 1, 3).reshape(
                 b, 1, self.heads * self.dim_head)
-            return self._out_proj(out, qw), cache_k, cache_v
-        dots = self._cache_dots(q * scale, k_vals, k_scale)
-        layout = self.pattern.block_layout()
-        row = pattern_mask_row(
-            self.pattern, index, n_k,
-            layout=jnp.asarray(layout) if layout is not None else None,
-        )[None, None, None, :]
-        row = _merge_key_pad_mask(self.pattern, row, mask)
-        dots = jnp.where(row, dots, max_neg_value(dots.dtype))
-        attn = jax.nn.softmax(dots, axis=-1)  # f32
-        out = self._attn_v(attn, v_vals, v_scale, x.dtype)
-        out = out.transpose(0, 2, 1, 3).reshape(b, 1, self.heads * self.dim_head)
         return self._out_proj(out, qw), cache_k, cache_v
 
     def _decode_step_aligned(self, x, q, k, v, cache_k, cache_v, index,
@@ -580,10 +594,11 @@ class MultiHeadAttention(nn.Module):
         # the ONE aligned write: every row's next token lands in the same
         # physical column, so this stays a dynamic_update_slice (in-place
         # under donation) instead of a scatter
-        cache_k = cache_write(cache_k, k, (0, 0, write_pos, 0))
-        cache_v = cache_write(cache_v, v, (0, 0, write_pos, 0))
-        k_vals, k_scale = split_cache(cache_k)
-        v_vals, v_scale = split_cache(cache_v)
+        with prof.scope("attn-cache"):
+            cache_k = cache_write(cache_k, k, (0, 0, write_pos, 0))
+            cache_v = cache_write(cache_v, v, (0, 0, write_pos, 0))
+            k_vals, k_scale = split_cache(cache_k)
+            v_vals, v_scale = split_cache(cache_v)
 
         sliced = (decode_key_positions(self.pattern, jnp.int32(0))
                   if self.sliced_kv_decode else None)
@@ -618,32 +633,37 @@ class MultiHeadAttention(nn.Module):
                                                          img_lo)
                     return jnp.concatenate([text, img], axis=2)
 
-                k_sub, v_sub = spans(k_vals), spans(v_vals)
+                with prof.scope("attn-cache"):
+                    k_sub, v_sub = spans(k_vals), spans(v_vals)
             else:
                 safe = jnp.clip(positions, 0, n_k - 1)
                 phys = jnp.remainder(safe + r[:, None], n_k)     # [b, m]
-                k_sub = jnp.take_along_axis(
-                    k_vals, phys[:, None, :, None], axis=2)      # [b,h,m,dh]
-                v_sub = jnp.take_along_axis(
-                    v_vals, phys[:, None, :, None], axis=2)
-            dots = self._cache_dots(q * scale, k_sub, k_scale)
-            row = (_allowed(self.pattern, idx[:, None], positions, jnp)
-                   & valid)[:, None, None, :]
-            dots = jnp.where(row, dots, max_neg_value(dots.dtype))
-            attn = jax.nn.softmax(dots, axis=-1)  # f32
-            out = self._attn_v(attn, v_sub, v_scale, x.dtype)
+                with prof.scope("attn-cache"):
+                    k_sub = jnp.take_along_axis(
+                        k_vals, phys[:, None, :, None], axis=2)  # [b,h,m,dh]
+                    v_sub = jnp.take_along_axis(
+                        v_vals, phys[:, None, :, None], axis=2)
+            with prof.scope("attn-scores"):
+                dots = self._cache_dots(q * scale, k_sub, k_scale)
+                row = (_allowed(self.pattern, idx[:, None], positions, jnp)
+                       & valid)[:, None, None, :]
+                dots = jnp.where(row, dots, max_neg_value(dots.dtype))
+                attn = jax.nn.softmax(dots, axis=-1)  # f32
+                out = self._attn_v(attn, v_sub, v_scale, x.dtype)
         else:
-            dots = self._cache_dots(q * scale, k_vals, k_scale)
-            logical = jnp.remainder(
-                jnp.arange(n_k, dtype=jnp.int32)[None, :] - r[:, None], n_k)
-            layout = self.pattern.block_layout()
-            row = _allowed(self.pattern, idx[:, None], logical, jnp,
-                           layout=(jnp.asarray(layout)
-                                   if layout is not None else None))
-            dots = jnp.where(row[:, None, None, :], dots,
-                             max_neg_value(dots.dtype))
-            attn = jax.nn.softmax(dots, axis=-1)  # f32
-            out = self._attn_v(attn, v_vals, v_scale, x.dtype)
+            with prof.scope("attn-scores"):
+                dots = self._cache_dots(q * scale, k_vals, k_scale)
+                logical = jnp.remainder(
+                    jnp.arange(n_k, dtype=jnp.int32)[None, :] - r[:, None],
+                    n_k)
+                layout = self.pattern.block_layout()
+                row = _allowed(self.pattern, idx[:, None], logical, jnp,
+                               layout=(jnp.asarray(layout)
+                                       if layout is not None else None))
+                dots = jnp.where(row[:, None, None, :], dots,
+                                 max_neg_value(dots.dtype))
+                attn = jax.nn.softmax(dots, axis=-1)  # f32
+                out = self._attn_v(attn, v_vals, v_scale, x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(b, 1, self.heads * self.dim_head)
         return self._out_proj(out, qw), cache_k, cache_v
 

@@ -23,6 +23,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..obs import prof
 from ..utils.helpers import cast_tuple, default
 from .attention import AttnPattern, MultiHeadAttention
 from .reversible import reversible_sequence, reversible_sequence_naive
@@ -77,20 +78,27 @@ class AttnBlock(nn.Module):
 
     def __call__(self, x, mask=None, deterministic: bool = True,
                  return_kv: bool = False):
-        out = self.attn(self.norm(x).astype(x.dtype), mask=mask,
+        with prof.scope("attn-qkv"):
+            normed = self.norm(x).astype(x.dtype)
+        out = self.attn(normed, mask=mask,
                         deterministic=deterministic, return_kv=return_kv)
         if return_kv:
             h, kv = out
-            return h * self.scale.astype(h.dtype), kv
-        return out * self.scale.astype(out.dtype)
+            with prof.scope("attn-out"):
+                return h * self.scale.astype(h.dtype), kv
+        with prof.scope("attn-out"):
+            return out * self.scale.astype(out.dtype)
 
     def decode_step(self, x, cache_k, cache_v, index, mask=None,
                     write_pos=None, qw=None):
+        with prof.scope("attn-qkv"):
+            normed = self.norm(x).astype(x.dtype)
         h, ck, cv = self.attn.decode_step(
-            self.norm(x).astype(x.dtype), cache_k, cache_v, index, mask=mask,
+            normed, cache_k, cache_v, index, mask=mask,
             write_pos=write_pos, qw=qw
         )
-        return h * self.scale.astype(h.dtype), ck, cv
+        with prof.scope("attn-out"):
+            return h * self.scale.astype(h.dtype), ck, cv
 
 
 class FFBlock(nn.Module):
@@ -121,19 +129,20 @@ class FFBlock(nn.Module):
         accumulation instead of touching the f32 params."""
         from .quant import qdense
 
-        normed = self.norm(x).astype(x.dtype)
-        if qw is not None:
-            h = qdense(normed, *qw["ff_in"]).astype(x.dtype)
-        else:
-            h = self.dense_in(normed)
-        h, gates = jnp.split(h, 2, axis=-1)
-        h = h * nn.gelu(gates)
-        h = self.drop(h, deterministic=deterministic)
-        if qw is not None:
-            h = qdense(h, *qw["ff_out"]).astype(x.dtype)
-        else:
-            h = self.dense_out(h)
-        return h * self.scale.astype(h.dtype)
+        with prof.scope("ff"):
+            normed = self.norm(x).astype(x.dtype)
+            if qw is not None:
+                h = qdense(normed, *qw["ff_in"]).astype(x.dtype)
+            else:
+                h = self.dense_in(normed)
+            h, gates = jnp.split(h, 2, axis=-1)
+            h = h * nn.gelu(gates)
+            h = self.drop(h, deterministic=deterministic)
+            if qw is not None:
+                h = qdense(h, *qw["ff_out"]).astype(x.dtype)
+            else:
+                h = self.dense_out(h)
+            return h * self.scale.astype(h.dtype)
 
 
 class MoEFFBlock(nn.Module):
@@ -170,10 +179,11 @@ class MoEFFBlock(nn.Module):
         )
 
     def __call__(self, x, deterministic: bool = True):
-        h, aux = self.moe(self.norm(x).astype(x.dtype),
-                          deterministic=deterministic)
-        self.sow("losses", "moe_aux", aux)
-        return h * self.scale.astype(h.dtype)
+        with prof.scope("ff"):
+            h, aux = self.moe(self.norm(x).astype(x.dtype),
+                              deterministic=deterministic)
+            self.sow("losses", "moe_aux", aux)
+            return h * self.scale.astype(h.dtype)
 
 
 class Transformer(nn.Module):

@@ -59,6 +59,7 @@ import jax.numpy as jnp
 
 from ..models.dalle import (DALLE, prefill_codes, quantize_decode_weights,
                             sample_image_code)
+from ..obs import prof
 from ..ops.quant import split_cache
 
 
@@ -213,29 +214,31 @@ class SlotArena:
             logical positions a resident actually wrote).  ``qweights``
             (weights_int8) rides as a real argument so the executable's
             weight stream is the int8 copies, never a baked-in constant."""
-            logits, caches = dalle.apply(
-                variables, state["code"], state["caches"], state["index"],
-                None, write_pos, qweights, method=DALLE.decode_step)
-            # per-slot key for THIS position, gathered from the pre-split
-            # stream (no threefry in the tick)
-            sub = jax.vmap(
-                lambda ks, p: jax.lax.dynamic_slice(ks, (p, 0), (1, 2))[0])(
-                    state["keys"], state["pos"])
-            sampled = jax.vmap(sample_one)(logits, sub, state["temp"])
+            with prof.scope("serve-tick"):
+                logits, caches = dalle.apply(
+                    variables, state["code"], state["caches"], state["index"],
+                    None, write_pos, qweights, method=DALLE.decode_step)
+                # per-slot key for THIS position, gathered from the pre-split
+                # stream (no threefry in the tick)
+                sub = jax.vmap(
+                    lambda ks, p: jax.lax.dynamic_slice(
+                        ks, (p, 0), (1, 2))[0])(state["keys"], state["pos"])
+                sampled = jax.vmap(sample_one)(logits, sub, state["temp"])
 
-            adv = active.astype(jnp.int32)
-            written = jax.vmap(
-                lambda row, p, val: jax.lax.dynamic_update_slice(
-                    row, val[None], (p,)))(state["out"], state["pos"], sampled)
-            return dict(
-                caches=caches,
-                code=jnp.where(active, sampled, state["code"]),
-                index=state["index"] + adv,
-                pos=state["pos"] + adv,
-                keys=state["keys"],
-                temp=state["temp"],
-                out=jnp.where(active[:, None], written, state["out"]),
-            )
+                adv = active.astype(jnp.int32)
+                written = jax.vmap(
+                    lambda row, p, val: jax.lax.dynamic_update_slice(
+                        row, val[None], (p,)))(state["out"], state["pos"],
+                                               sampled)
+                return dict(
+                    caches=caches,
+                    code=jnp.where(active, sampled, state["code"]),
+                    index=state["index"] + adv,
+                    pos=state["pos"] + adv,
+                    keys=state["keys"],
+                    temp=state["temp"],
+                    out=jnp.where(active[:, None], written, state["out"]),
+                )
 
         self._prefill = jax.jit(prefill)
         self._admit = jax.jit(admit, donate_argnums=(0,))

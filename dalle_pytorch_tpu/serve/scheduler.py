@@ -129,6 +129,19 @@ class GenerationServer:
         self._tel = tel
         self._metrics_labels = dict(metrics_labels or {})
         self.num_slots = num_slots
+        # the cost-model's HBM stream per decoded token for THIS arena
+        # (cache payload + int8 scale planes, matching
+        # profiling.dalle_decode_cache_bytes) — static per server, joined
+        # against measured tok/s by monitor --fleet / graftprof --report
+        from ..obs import prof
+        self.predicted_bytes_per_token = prof.predicted_serve_bytes_per_token(
+            dalle.cfg, num_slots)
+        reg = obs_metrics.active()
+        if reg is not None:
+            reg.gauge("graft_serve_predicted_bytes_per_token",
+                      "cost-model HBM bytes per decoded token",
+                      **self._metrics_labels
+                      ).set(self.predicted_bytes_per_token)
         # telemetry tick sampling: emit one aggregate `serve tick` record
         # per `tick_sample` decode ticks instead of 1:1 — a week-long serve
         # process at ~10ms/tick writes ~8.6M tick records a day unsampled.
@@ -465,6 +478,12 @@ class GenerationServer:
                             / (agg["ticks"] * self.num_slots))
             reg.counter("graft_serve_ticks_total", "decode ticks run",
                         **self._metrics_labels).inc(agg["ticks"])
+            # re-assert the static byte-stream gauge here too: the
+            # registry may have been installed after __init__ ran
+            reg.gauge("graft_serve_predicted_bytes_per_token",
+                      "cost-model HBM bytes per decoded token",
+                      **self._metrics_labels
+                      ).set(self.predicted_bytes_per_token)
         self._tick_agg = {"ticks": 0, "active_sum": 0, "active_min": None,
                           "active_max": 0, "clock_first": None}
 
@@ -592,6 +611,7 @@ class GenerationServer:
         return dict(
             ticks=self._ticks,
             decoded_tokens=tokens,
+            predicted_bytes_per_token=self.predicted_bytes_per_token,
             queue_depth=queue_depth,
             tok_per_s=(tokens / window_seconds
                        if window_seconds else None),

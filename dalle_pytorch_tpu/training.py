@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .obs import prof
 from .parallel.mesh import shard_map
 from .utils import guardrails
 
@@ -98,15 +99,17 @@ def make_vae_train_step(vae, tx, donate: bool = True, health: bool = False,
 
         (loss, recons), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         if health:
-            params, opt_state, hv = guardrails.guarded_update(
-                tx, grads, opt_state, params, loss=loss, guard=guard)
+            with prof.scope("optimizer"):
+                params, opt_state, hv = guardrails.guarded_update(
+                    tx, grads, opt_state, params, loss=loss, guard=guard)
+                params, opt_state = _pin_update_shardings(partitioner, params,
+                                                          opt_state)
+            return params, opt_state, loss, recons, hv
+        with prof.scope("optimizer"):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
             params, opt_state = _pin_update_shardings(partitioner, params,
                                                       opt_state)
-            return params, opt_state, loss, recons, hv
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        params, opt_state = _pin_update_shardings(partitioner, params,
-                                                  opt_state)
         return params, opt_state, loss, recons
 
     return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
@@ -158,15 +161,17 @@ def make_dalle_train_step(dalle, tx, vae=None, donate: bool = True,
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         if health:
-            params, opt_state, hv = guardrails.guarded_update(
-                tx, grads, opt_state, params, loss=loss, guard=guard)
+            with prof.scope("optimizer"):
+                params, opt_state, hv = guardrails.guarded_update(
+                    tx, grads, opt_state, params, loss=loss, guard=guard)
+                params, opt_state = _pin_update_shardings(partitioner, params,
+                                                          opt_state)
+            return params, opt_state, loss, hv
+        with prof.scope("optimizer"):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
             params, opt_state = _pin_update_shardings(partitioner, params,
                                                       opt_state)
-            return params, opt_state, loss, hv
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        params, opt_state = _pin_update_shardings(partitioner, params,
-                                                  opt_state)
         return params, opt_state, loss
 
     if not jit:
@@ -234,13 +239,15 @@ def make_dalle_sp_train_step(dalle, tx, mesh, dp_axis: str = "dp",
 
             (loss, ok), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            params, opt_state, hv = guardrails.guarded_update(
-                tx, grads, opt_state, params, loss=loss, extra_ok=ok,
-                guard=guard)
+            with prof.scope("optimizer"):
+                params, opt_state, hv = guardrails.guarded_update(
+                    tx, grads, opt_state, params, loss=loss, extra_ok=ok,
+                    guard=guard)
             return params, opt_state, loss, hv
         loss, grads = jax.value_and_grad(global_loss)(params, text, codes, rng)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        with prof.scope("optimizer"):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
@@ -276,7 +283,11 @@ def make_dalle_pp_train_step(dalle, tx, params, mesh, *,
     def loss_fn(p, text, codes):
         tokens = dalle.apply({"params": p["outer"]}, text, codes,
                              cfg.onehot_embed, method=DALLE.embed_sequence)
-        h = apply_fn(p["stages"], tokens)
+        # "pipeline" charges the schedule machinery (microbatch buffers,
+        # ppermute shifts); the blocks' own scopes win inside (innermost
+        # graftprof frame takes the eqn)
+        with prof.scope("pipeline"):
+            h = apply_fn(p["stages"], tokens)
         return dalle.apply({"params": p["outer"]}, h, text, codes,
                            method=DALLE.loss_from_hidden)
 
@@ -291,11 +302,13 @@ def make_dalle_pp_train_step(dalle, tx, params, mesh, *,
             # grads/loss here are jit-level global values (GSPMD reduces
             # them identically on every host and stage), so the plain
             # sentinel is already a collective decision
-            pp_params, opt_state, hv = guardrails.guarded_update(
-                tx, grads, opt_state, pp_params, loss=loss, guard=guard)
+            with prof.scope("optimizer"):
+                pp_params, opt_state, hv = guardrails.guarded_update(
+                    tx, grads, opt_state, pp_params, loss=loss, guard=guard)
             return pp_params, opt_state, loss, hv
-        updates, opt_state = tx.update(grads, opt_state, pp_params)
-        pp_params = optax.apply_updates(pp_params, updates)
+        with prof.scope("optimizer"):
+            updates, opt_state = tx.update(grads, opt_state, pp_params)
+            pp_params = optax.apply_updates(pp_params, updates)
         return pp_params, opt_state, loss
 
     return (jax.jit(train_step, donate_argnums=(0, 1) if donate else ()),
@@ -326,15 +339,17 @@ def make_clip_train_step(clip, tx, donate: bool = True, health: bool = False,
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         if health:
-            params, opt_state, hv = guardrails.guarded_update(
-                tx, grads, opt_state, params, loss=loss, guard=guard)
+            with prof.scope("optimizer"):
+                params, opt_state, hv = guardrails.guarded_update(
+                    tx, grads, opt_state, params, loss=loss, guard=guard)
+                params, opt_state = _pin_update_shardings(partitioner, params,
+                                                          opt_state)
+            return params, opt_state, loss, hv
+        with prof.scope("optimizer"):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
             params, opt_state = _pin_update_shardings(partitioner, params,
                                                       opt_state)
-            return params, opt_state, loss, hv
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        params, opt_state = _pin_update_shardings(partitioner, params,
-                                                  opt_state)
         return params, opt_state, loss
 
     return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())

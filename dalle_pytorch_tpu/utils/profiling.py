@@ -249,12 +249,15 @@ class StepTimer:
 
 @contextlib.contextmanager
 def profile_trace(logdir: str = "/tmp/jax-trace", enabled: bool = True):
-    """`jax.profiler` trace context (view with XProf/TensorBoard)."""
+    """`jax.profiler` trace context (view with XProf/TensorBoard).
+
+    Delegates to :func:`obs.prof.capture` — the repo's one managed
+    profiler entry point (graftlint OBS003) — so the trace window also
+    lands as a ``prof.xprof`` span in the telemetry stream."""
     if not enabled:
         yield
         return
-    jax.profiler.start_trace(logdir)
-    try:
+    from ..obs import prof
+
+    with prof.capture(logdir):
         yield
-    finally:
-        jax.profiler.stop_trace()

@@ -420,6 +420,52 @@ def test_obs002_pragma_with_reason_suppresses():
                        path="dalle_pytorch_tpu/utils/x.py") == []
 
 
+# --- OBS003 --------------------------------------------------------------
+
+
+def test_obs003_direct_profiler_calls_flagged():
+    """Unmanaged jax.profiler entry points leave on-chip trace windows
+    the telemetry stream never hears about — flagged everywhere (trainers
+    and tools included: the capture must ride a prof.xprof span)."""
+    src = """
+    import jax
+    def window(logdir):
+        jax.profiler.start_trace(logdir)
+        work()
+        jax.profiler.stop_trace()
+    def ctx(logdir):
+        with jax.profiler.trace(logdir):
+            work()
+    """
+    for path in ("train_dalle.py", "tools/perf_ab.py",
+                 "dalle_pytorch_tpu/utils/profiling.py"):
+        assert rules_of(lint(src, select=("OBS003",),
+                             path=path)) == ["OBS003"] * 3, path
+
+
+def test_obs003_prof_module_exempt_and_capture_clean():
+    """obs/prof.py IS the managed entry point (exempt); call sites using
+    prof.capture / XprofWindow are what the rule migrates code toward."""
+    raw = "import jax\njax.profiler.start_trace('/tmp/x')\n"
+    assert lint_source(raw, select=("OBS003",),
+                       path="dalle_pytorch_tpu/obs/prof.py") == []
+    managed = """
+    from dalle_pytorch_tpu.obs import prof
+    with prof.capture("/tmp/x"):
+        work()
+    prof.XprofWindow(logdir="/tmp/x").on_step(0)
+    """
+    assert lint(managed, select=("OBS003",), path="train_dalle.py") == []
+
+
+def test_obs003_pragma_with_reason_suppresses():
+    src = ("import jax\n"
+           "jax.profiler.start_trace('/tmp/x')  "
+           "# graftlint: disable=OBS003 (throwaway debugging scratch, no "
+           "telemetry stream attached)\n")
+    assert lint_source(src, select=("OBS003",), path="tools/scratch.py") == []
+
+
 # --- SRV001 --------------------------------------------------------------
 
 
@@ -906,8 +952,8 @@ def test_every_rule_has_fixture_coverage():
     """Meta: the rule registry and this file stay in sync — adding a rule
     without positive-fixture coverage fails here."""
     covered = {"ENV001", "SEED001", "BACKEND001", "DOT001", "TRACE001",
-               "EXC001", "CKPT001", "OBS001", "OBS002", "SRV001", "DON001",
-               "DON002"}
+               "EXC001", "CKPT001", "OBS001", "OBS002", "OBS003", "SRV001",
+               "DON001", "DON002"}
     assert covered == set(RULES)
 
 

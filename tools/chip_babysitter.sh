@@ -31,7 +31,7 @@ cd "$(dirname "$0")/.."
 # stage's command line changes.
 QV=11
 
-STAGES="gen_bf16_ab gen_int8_ab gen_fused_ab ab_cand bench gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap bench_serve"
+STAGES="gen_bf16_ab gen_int8_ab gen_fused_ab ab_cand bench xprof_capture gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap bench_serve"
 
 # Overridable knobs so tests/test_babysitter.py can drive the REAL script
 # (fake python on PATH, private marker dir, second-scale sleeps) without
@@ -279,6 +279,19 @@ run_stage ab_cand   1500 python tools/perf_ab.py baseline candidate --reps 3
 # headline bench record (writes all-logs-tpu/bench-history.jsonl): one gen
 # batch only — two cold decode-scan compiles can outlive the stage timeout
 run_stage bench     2400 env BENCH_VAE=1 BENCH_GEN_BATCHES=8 python bench.py
+# measured on-chip trace for the perf ledger (ISSUE 14): a short
+# loss-parity run with the env-armed GRAFT_XPROF window over steps
+# [32,36) — 32 warm steps, then prof.capture opens a managed
+# jax.profiler trace (OBS003) for two 2-step chunks.  The trace dir is
+# written STRAIGHT into chip-logs/ (not CHIP_TMP: the harvest loop only
+# copies stage logs, and a multi-file xprof dump shouldn't round-trip
+# through /tmp), so the end-of-round commit carries the measured trace
+# beside PERF_LEDGER.json's predicted rows — graftprof --report joins
+# the two, the trace explains any gap.
+run_stage xprof_capture 1500 env GRAFT_XPROF=all-logs-tpu/chip-logs/xprof \
+  GRAFT_XPROF_WINDOW=32:36 python tools/loss_curve.py --captions synthetic \
+  --steps 48 --num_pairs 2048 --batch_size 16 --chunk 2 \
+  --out "${CHIP_TMP}/xprof_loss.txt"
 # sliced-KV decode A/B (north-star #2): gen vs its dense-cache control.
 # batch 64 is a SEPARATE stage — each variant here is a cold decode-scan
 # compile (bench.py bounds ONE at 900s), so two per stage is the ceiling

@@ -275,6 +275,18 @@ def main(argv=None):
     chunk = max(1, args.chunk)
     raw_step = make_dalle_train_step(model, tx, jit=False)
 
+    # env-armed on-chip capture (GRAFT_XPROF / GRAFT_XPROF_WINDOW): the
+    # babysitter's xprof_capture stage points this at chip-logs/ so a
+    # measured trace of the loss-parity workload rides the end-of-round
+    # commit beside PERF_LEDGER.json's predicted rows.  The window snaps
+    # to chunk boundaries (on_step fires per chunk, not per step) — use
+    # --chunk 1..4 when arming so the capture stays a few steps wide.
+    from dalle_pytorch_tpu.obs import prof
+    xprof = prof.XprofWindow()
+
+    def drain():
+        jax.block_until_ready(params)
+
     import functools
 
     @functools.partial(jax.jit, static_argnames="n", donate_argnums=(0, 1, 2))
@@ -351,6 +363,7 @@ def main(argv=None):
                         flip, nr.integers(0, cfg.num_image_tokens,
                                           chunk_codes[j].shape),
                         chunk_codes[j])
+            xprof.on_step(start, sync=drain)
             params, opt_state, rng, losses = run_chunk(
                 params, opt_state, rng, jnp.asarray(caps[sel]),
                 jnp.asarray(chunk_codes), n)
@@ -374,6 +387,7 @@ def main(argv=None):
             rate = (start - done_before) / (time.time() - t0)
             print(f"step {start - 1}: loss {float(host_losses[-1]):.4f} "
                   f"({rate:.2f} steps/s)", flush=True)
+    xprof.close(sync=drain)  # exit-path safety net (window past --steps)
     print(f"wrote {args.steps} lines to {out}")
 
 

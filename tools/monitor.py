@@ -198,7 +198,8 @@ _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 def _scrape_replica_metrics(url: str, timeout: float = 3.0
                             ) -> dict[str, dict]:
     """GET an endpoint's /metrics and fold the per-replica serve series
-    into ``{replica: {state, queue: {slo: depth}, occupancy}}``.  Only
+    into ``{replica: {state, queue: {slo: depth}, occupancy,
+    bytes_per_token}}``.  Only
     replica-labeled series participate (a single-server trainer's
     unlabeled gauges are not a fleet)."""
     import urllib.request
@@ -231,6 +232,8 @@ def _scrape_replica_metrics(url: str, timeout: float = 3.0
             info["queue"][labels.get("slo", "?")] = v
         elif name == "graft_serve_occupancy":
             info["occupancy"] = v
+        elif name == "graft_serve_predicted_bytes_per_token":
+            info["bytes_per_token"] = v
     return out
 
 
@@ -258,6 +261,12 @@ def _print_replica_metrics(urls: list[str]) -> int:
                     for slo, d in sorted(info["queue"].items())))
             if info.get("occupancy") is not None:
                 bits.append(f"occupancy {info['occupancy']:.2f}")
+            if info.get("bytes_per_token") is not None:
+                # the arena's cost-model HBM stream per decoded token
+                # (scheduler.predicted_bytes_per_token): occupancy says how
+                # busy a replica is, this says how heavy each token is
+                bits.append(
+                    f"pred {info['bytes_per_token'] / 2**20:.2f} MiB/tok")
             flag = "  << DOWN" if state == "dead" else ""
             print(f"replica {name} [{url}]: {' '.join(bits)}{flag}")
     return bad

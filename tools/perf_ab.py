@@ -20,6 +20,8 @@ benchmark.
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import os
 import statistics
 import sys
 from pathlib import Path
@@ -27,6 +29,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 VARIANTS = {
@@ -132,52 +135,69 @@ def main(argv=None) -> int:
     import bench
     from dalle_pytorch_tpu.cli import (apply_platform_env,
                                       enable_compilation_cache)
+    from dalle_pytorch_tpu.obs import prof
 
     apply_platform_env()  # JAX_PLATFORMS=cpu wins over the tunnel pin
     enable_compilation_cache()  # variant recompiles across runs hit the cache
 
     measures = {}
+    # name -> bench.ledger_keys(...): the PERF_LEDGER.json join key built
+    # from the cfg the measured loop actually traced, so each variant's
+    # median lands beside graftprof's predicted row (or as a measured-only
+    # stub at geometries the sweep doesn't cover)
+    ledger_info = {}
     for name in args.variants:
         print(f"compiling {name}...", file=sys.stderr, flush=True)
+
+        def gen_measure(b, **ov):
+            compile_fn, cfg = bench.make_gen_measure_deferred(batch=b, **ov)
+            ledger_info[name] = bench.ledger_keys(
+                cfg, target="decode", plan="single", batch=b)
+            return compile_fn()
+
         if name in ("gen", "gen64"):
-            measures[name] = bench.make_gen_measure(
-                batch=64 if name == "gen64" else 8)
+            measures[name] = gen_measure(64 if name == "gen64" else 8)
         elif name == "gen-dense":
             # the dense-cache control: the same sampler with
             # DALLEConfig.sliced_kv_decode=False, so the choice is part of
             # the traced config — a retrace can never silently measure the
             # sliced path under the gen-dense label
-            measures[name] = bench.make_gen_measure(batch=8,
-                                                    sliced_kv_decode=False)
+            measures[name] = gen_measure(8, sliced_kv_decode=False)
         elif name in ("gen_bf16", "gen_f32cache"):
             # f32 activations (the eval path's dtype: checkpoints carry no
             # dtype, so loaded models run f32) with the bf16 KV cache on
             # vs off — like gen-dense, the choice rides the traced config
-            measures[name] = bench.make_gen_measure(
-                batch=8, dtype=jnp.float32,
-                kv_cache_bf16=(name == "gen_bf16"))
+            measures[name] = gen_measure(
+                8, dtype=jnp.float32, kv_cache_bf16=(name == "gen_bf16"))
         elif name == "gen_int8":
             # int8 quantized serving (ISSUE 7) at the eval path's f32
             # activations: int8 cache + int8 decode weights, both riding
             # the traced config — A/B control is gen_bf16
-            measures[name] = bench.make_gen_measure(
-                batch=8, dtype=jnp.float32, kv_cache_int8=True,
-                weights_int8=True)
+            measures[name] = gen_measure(
+                8, dtype=jnp.float32, kv_cache_int8=True, weights_int8=True)
         elif name == "gen_fused_rank":
             measures[name] = bench.make_fused_rank_measure(batch=8)
-        elif name in ("serve64", "serve16"):
-            measures[name] = bench.make_serve_measure(
-                num_slots=64 if name == "serve64" else 16)
-        elif name == "serve_int8":
-            # the quantized 64-slot arena (per-slot scale planes, int8
-            # weight args per tick) vs serve64's bf16 arena
-            measures[name] = bench.make_serve_measure(
-                num_slots=64, kv_cache_int8=True, weights_int8=True)
+        elif name in ("serve64", "serve16", "serve_int8"):
+            # serve_int8: the quantized 64-slot arena (per-slot scale
+            # planes, int8 weight args per tick) vs serve64's bf16 arena
+            slots = 16 if name == "serve16" else 64
+            ov = (dict(kv_cache_int8=True, weights_int8=True)
+                  if name == "serve_int8" else {})
+            ledger_info[name] = bench.ledger_keys(
+                dataclasses.replace(bench.cub200_config(), **ov),
+                target="serve-tick", plan="single", batch=slots,
+                num_slots=slots)
+            measures[name] = bench.make_serve_measure(num_slots=slots, **ov)
         elif name == "vae":
             measures[name] = bench.make_vae_measure()
+            ledger_info[name] = bench.ledger_keys(
+                bench.vae128_config(), target="vae", plan="single", batch=8)
         else:
-            measures[name] = bench.make_train_measure(
-                args.steps, **VARIANTS[name])[0]
+            measure, cfg, batch = bench.make_train_measure(
+                args.steps, **VARIANTS[name])
+            measures[name] = measure
+            ledger_info[name] = bench.ledger_keys(
+                cfg, target="dalle/dp", plan="dp", batch=batch)
 
     def unit(name):
         if name == "gen_fused_rank":  # rank_codes reports whole images
@@ -197,6 +217,29 @@ def main(argv=None) -> int:
     for name, vals in results.items():
         print(f"  {name:12s} {statistics.median(vals):9.2f} {unit(name)}  "
               f"(spread {min(vals):.2f}-{max(vals):.2f})")
+
+    # medians join PERF_LEDGER.json under the prediction's fingerprint
+    # (real chip only, like bench.record_history's history line;
+    # GRAFT_PERF_LEDGER arms a scratch ledger so CPU smoke can exercise
+    # the join).  `graftprof --report` renders predicted-vs-measured.
+    if ledger_info and (jax.devices()[0].platform != "cpu"
+                        # graftlint: disable=ENV001 (path-valued var: set at all arms a scratch ledger)
+                        or os.environ.get("GRAFT_PERF_LEDGER")):
+        appended = 0
+        for name, vals in results.items():
+            info = ledger_info.get(name)
+            if info is None:  # e.g. gen_fused_rank spans three models
+                continue
+            prof.append_measured(
+                {"metric": f"perf_ab:{name}",
+                 "value": round(statistics.median(vals), 2),
+                 "unit": unit(name), "reps": args.reps},
+                fingerprint=info["ledger_fingerprint"],
+                target=info["ledger_target"])
+            appended += 1
+        if appended:
+            print(f"ledger: {appended} measured row(s) -> "
+                  f"{prof.ledger_path()}", file=sys.stderr)
     return 0
 
 

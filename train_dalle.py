@@ -36,6 +36,7 @@ from dalle_pytorch_tpu import DALLE, DALLEConfig, DiscreteVAE, VAEConfig
 from dalle_pytorch_tpu.cli import host_fetch, select_tokenizer, enable_compilation_cache
 from dalle_pytorch_tpu.data.dataset import DataLoader, TextImageDataset
 from dalle_pytorch_tpu.models.dalle import generate_codes
+from dalle_pytorch_tpu.obs import prof
 from dalle_pytorch_tpu.obs import telemetry as obs
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
 from dalle_pytorch_tpu.training import (make_dalle_train_step, make_optimizer,
@@ -95,6 +96,12 @@ def parse_args(argv=None):
     parser.add_argument('--profile_dir', type=str, default=None,
                         help='write a jax.profiler trace of steps 10-20 of '
                              'the first epoch to this dir (XProf/TensorBoard)')
+    parser.add_argument('--xprof_dir', type=str, default=None,
+                        help='managed on-chip trace window (obs/prof.py '
+                             'capture: the trace rides a prof.xprof '
+                             'telemetry span); GRAFT_XPROF env arms it '
+                             'without a flag, GRAFT_XPROF_WINDOW=a:b moves '
+                             'the step window. Alias of --profile_dir')
     parser.add_argument('--heartbeat_dir', type=str, default=None,
                         help='write per-process heartbeat-p{i}.json progress '
                              'files here for external stall/death monitors')
@@ -850,6 +857,23 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                  resumed_from=(str(args.dalle_path)
                                if exists(args.dalle_path) else None),
                  trainer='train_dalle')
+        # predicted-vs-measured: announce the perf ledger's roofline
+        # ceiling for this config (exact fingerprint first, plan-level
+        # fallback).  obs_report joins it with StepTimer's measured MFU;
+        # the mfu_vs_predicted alert rule reads it as its reference.
+        import dataclasses as _dc
+        _plan_name = args.run_plan.name
+        _prof_target = ('dalle_pp' if pp_mode else
+                        'dalle_sp' if sp_plan else 'dalle') + '/' + _plan_name
+        _pred = prof.predicted_for(
+            fingerprint=prof.row_fingerprint({
+                **{k: str(v) for k, v in
+                   sorted(_dc.asdict(dalle_cfg).items())},
+                'target': _prof_target, 'plan': _plan_name,
+                'batch': BATCH_SIZE * jax.process_count()}),
+            target=_prof_target, plan=_plan_name)
+        if _pred is not None:
+            obs.emit('prof', 'predicted', target=_prof_target, **_pred)
 
     @jax.jit
     def decode_images(vae_params, codes):
@@ -963,7 +987,16 @@ def _main(argv, lr_scale=1.0, skip_past=None):
         dalle_cfg, BATCH_SIZE * jax.process_count()))
     lr = sched.lr
     global_step = start_step
-    profiling_active = False
+    # managed on-chip trace window (steps 10-20 of the first trained
+    # epoch, past compile + warmup), root process only.  --profile_dir is
+    # the legacy alias of --xprof_dir; both route through prof.capture so
+    # the trace rides a prof.xprof telemetry span (graftlint OBS003).
+    xprof = prof.XprofWindow(
+        logdir=args.xprof_dir or args.profile_dir,
+        start=min(10, max(len(dl) - 2, 0)),
+        stop=min(20, max(len(dl) - 1, 1)))
+    if not distr_backend.is_root_worker() or len(dl) < 2:
+        xprof.logdir = None  # root-only, like the legacy window
     # preemption-safe shutdown + stall detection (SURVEY.md §5.3 — the
     # reference has neither): SIGTERM/SIGINT checkpoint-and-stop, heartbeat
     # files for external monitors, in-process hung-step watchdog
@@ -1084,21 +1117,16 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                             heartbeat.beat(global_step, epoch=epoch,
                                            health_state='skipping-window')
                         continue
-                    # profiler window: steps 10-20 of the first trained epoch (past
-                    # compile + warmup), root process only (ref had no profiler at
-                    # all — SURVEY.md §5.1)
-                    if args.profile_dir and epoch == start_epoch and \
-                            distr_backend.is_root_worker():
-                        window = (min(10, len(dl) - 2), min(20, len(dl) - 1)) \
-                            if len(dl) >= 2 else (None, None)
-                        if i == window[0]:
-                            jax.profiler.start_trace(args.profile_dir)
-                            profiling_active = True
-                        elif i == window[1] and profiling_active:
-                            jax.block_until_ready(params)
-                            jax.profiler.stop_trace()
-                            profiling_active = False
-                            print(f'profiler trace written to {args.profile_dir}')
+                    # profiler window (ref had no profiler at all —
+                    # SURVEY.md §5.1): prof.XprofWindow opens/closes the
+                    # managed capture around the step window
+                    if xprof.armed and epoch == start_epoch:
+                        was_active = xprof.active
+                        xprof.on_step(
+                            i, sync=lambda: jax.block_until_ready(params))
+                        if was_active and not xprof.active:
+                            print('profiler trace written to '
+                                  f'{xprof.logdir}')
                     if watchdog is not None:
                         # armed across the whole step iteration (dispatch,
                         # previous step's host sync, periodic sample/save) —
@@ -1225,6 +1253,9 @@ def _main(argv, lr_scale=1.0, skip_past=None):
 
             completed = not interrupted
     finally:
+        # a death inside the trace window must still stop the profiler
+        # (and close its telemetry span) before the stream shuts down
+        xprof.close()
         if manager is not None:
             # join the in-flight async checkpoint write: the process must
             # not exit (or report resume state) with an uncommitted save

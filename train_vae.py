@@ -454,6 +454,19 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                  config_fingerprint=config_fingerprint(cfg.to_dict()),
                  resumed_from=args.resume_path or None,
                  trainer='train_vae')
+        # predicted-vs-measured: the perf ledger's roofline ceiling for
+        # the VAE step (exact geometry fingerprint, else the target row)
+        import dataclasses as _dc
+
+        from dalle_pytorch_tpu.obs import prof
+        _pred = prof.predicted_for(
+            fingerprint=prof.row_fingerprint({
+                **{k: str(v) for k, v in sorted(_dc.asdict(cfg).items())},
+                'target': 'vae', 'plan': 'single',
+                'batch': BATCH_SIZE * jax.process_count()}),
+            target='vae', plan='single')
+        if _pred is not None:
+            obs.emit('prof', 'predicted', target='vae', **_pred)
 
     # jitted eval helpers for the periodic "hard reconstruction" probe
     # (ref train_vae.py:187-209): codebook indices -> decode.
