@@ -477,6 +477,46 @@ def rule_obs003(ctx: FileCtx) -> Iterator[RuleHit]:
             yield node, msg
 
 
+# --- MEM001: unmanaged device-memory polling entry points ------------------
+
+_MEM1_POLL_CALLS = frozenset(("device_memory_profile",
+                              "profiler.device_memory_profile",
+                              "live_arrays"))
+_MEM1_EXEMPT = (("obs", "mem.py"),)
+
+
+def rule_mem001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """A direct ``jax.profiler.device_memory_profile`` /
+    ``jax.live_arrays`` call outside ``obs/mem.py`` produces a memory
+    sample the observability stack never hears about: no
+    ``mem.watermark`` telemetry record, no ``graft_hbm_*`` gauges, no
+    ``hbm_headroom`` alert input, and the serve leak gate's baseline
+    census can't account for it (a stray ``live_arrays()`` in a hot loop
+    is itself a way to pin buffers).  Route polling through
+    ``obs.mem.MemTracker`` / ``mem.live_buffer_stats`` /
+    ``mem.device_memory_stats`` / ``mem.write_device_memory_profile`` —
+    the OBS003 one-managed-entry-point discipline, applied to the
+    memory APIs; pragma with a reason where a raw call is genuinely
+    correct (e.g. a debugging scratch script)."""
+    msg = ("direct jax device-memory poll outside obs/mem.py: the sample "
+           "never lands in the telemetry stream (no mem.watermark record, "
+           "no graft_hbm_* gauges, no hbm_headroom alert input, invisible "
+           "to the serve leak-gate baseline); use dalle_pytorch_tpu.obs."
+           "mem.MemTracker / live_buffer_stats / device_memory_stats / "
+           "write_device_memory_profile, or pragma with why an unmanaged "
+           "poll is correct here")
+    parts = tuple(ctx.path.replace("\\", "/").split("/"))
+    if any(parts[-len(ex):] == ex for ex in _MEM1_EXEMPT):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if any(chain == c or chain.endswith("." + c)
+               for c in _MEM1_POLL_CALLS):
+            yield node, msg
+
+
 # --- SRV001: unbounded blocking waits in serve/ ---------------------------
 
 _SRV_BLOCKING = frozenset(("result", "get", "acquire"))
@@ -761,6 +801,7 @@ RULES = {
     "OBS001": rule_obs001,
     "OBS002": rule_obs002,
     "OBS003": rule_obs003,
+    "MEM001": rule_mem001,
     "SRV001": rule_srv001,
     "DON001": rule_don001,
     "DON002": rule_don002,

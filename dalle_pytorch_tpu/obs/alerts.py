@@ -100,6 +100,14 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
     Rule(name="heartbeat_gap", kind="gap", select_kind="step",
          limit=120.0, window_s=0.0, min_count=1, cooldown_s=60.0,
          describe="the stream went quiet between steps"),
+    # min_count=1: watermarks are sparse phase-boundary polls
+    # (obs/mem.py MemTracker), and ONE sample under 5% headroom must
+    # page before the allocator OOMs, not after three more phases
+    Rule(name="hbm_headroom", kind="threshold", select_kind="mem",
+         select_names=("watermark",), field="headroom_frac", op="<",
+         limit=0.05, window_s=120.0, min_count=1, cooldown_s=600.0,
+         describe="HBM headroom under 5% of the device limit — the "
+                  "next allocation spike OOMs"),
 )
 
 

@@ -236,6 +236,21 @@ class MetricsRegistry:
                 self.gauge("graft_predicted_mfu",
                            "roofline-predicted MFU ceiling "
                            "(PERF_LEDGER.json)").set(float(rec["mfu"]))
+        elif kind == "mem" and rec.get("name") == "watermark":
+            # MemTracker phase-boundary polls (obs/mem.py) — the HBM
+            # panel the hbm_headroom alert watches
+            if rec.get("used_bytes") is not None:
+                self.gauge("graft_hbm_used_bytes",
+                           "device memory in use at the last "
+                           "mem.watermark").set(float(rec["used_bytes"]))
+            if rec.get("peak_bytes") is not None:
+                self.gauge("graft_hbm_peak_bytes",
+                           "high-watermark device memory").set(
+                    float(rec["peak_bytes"]))
+            if rec.get("headroom_bytes") is not None:
+                self.gauge("graft_hbm_headroom_bytes",
+                           "bytes of HBM left before the limit").set(
+                    float(rec["headroom_bytes"]))
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):

@@ -203,6 +203,42 @@ def build_report(events: List[dict]) -> dict:
                               else None),
         }
 
+    # --- memory: predicted vs measured --------------------------------------
+    # MemTracker emits `mem.watermark` at phase boundaries (obs/mem.py)
+    # and trainers emit one `mem.predicted` record (the ledger's memory
+    # timeline for their fingerprint); the join answers "is this run's
+    # HBM where the ledger says it should be, and how close to the edge"
+    marks = [r for r in events if r.get("kind") == "mem"
+             and r.get("name") == "watermark" and "ph" not in r]
+    mem_pred = [r for r in events if r.get("kind") == "mem"
+                and r.get("name") == "predicted" and "ph" not in r]
+    mem_report: Optional[dict] = None
+    if marks or mem_pred:
+        by_phase: dict = {}
+        for r in marks:  # last watermark per phase wins
+            by_phase[str(r.get("phase", "?"))] = {
+                k: r.get(k) for k in
+                ("live_count", "live_bytes", "used_bytes", "peak_bytes",
+                 "rss_bytes", "headroom_bytes", "headroom_frac")
+                if r.get(k) is not None}
+        leaks = [r for r in events if r.get("kind") == "mem"
+                 and r.get("name") == "leak_check" and "ph" not in r]
+        mem_report = {
+            "watermarks": by_phase,
+            "peak_bytes": max((int(r.get("peak_bytes", 0)) for r in marks),
+                              default=None),
+            "headroom_frac_min": min(
+                (float(r["headroom_frac"]) for r in marks
+                 if r.get("headroom_frac") is not None), default=None),
+            "predicted": ({k: mem_pred[-1].get(k) for k in
+                           ("fingerprint", "exact", "chip", "phases",
+                            "peak_phase", "peak_bytes", "headroom_frac",
+                            "fits")} if mem_pred else None),
+            "leak_checks": {"total": len(leaks),
+                            "failed": sum(not r.get("ok", True)
+                                          for r in leaks)},
+        }
+
     # --- faults / data ------------------------------------------------------
     faults = [{"site": r.get("name"), "action": r.get("action"),
                "step": r.get("step"), "hits": r.get("hits"),
@@ -226,6 +262,7 @@ def build_report(events: List[dict]) -> dict:
         "ckpt": ckpt_report,
         "serve": serve_report,
         "prof": prof_report,
+        "mem": mem_report,
         "faults": faults,
         "data": data_report,
         "torn_spans": [{"kind": r.get("kind"), "name": r.get("name"),
@@ -392,6 +429,39 @@ def render_text(report: dict) -> str:
             f"measured: mfu {_fmt(prof.get('measured_mfu'))}, step_time p50 "
             f"{_fmt(prof.get('measured_step_time_p50'))}s -> attained "
             f"{_fmt(prof.get('attained_frac'))} of ceiling")
+
+    memr = report.get("mem")
+    if memr:
+        lines.append("-- memory (predicted vs measured) --")
+        pred = memr.get("predicted")
+        if pred:
+            phases = pred.get("phases") or {}
+            phase_txt = " ".join(
+                f"{k}={int(v) / 2**20:.0f}MiB"
+                for k, v in sorted(phases.items()))
+            lines.append(
+                f"ledger {pred.get('fingerprint')} "
+                f"({'exact' if pred.get('exact') else 'plan-level'}, chip "
+                f"{pred.get('chip')}): {phase_txt} -> peak "
+                f"@{pred.get('peak_phase')}, headroom "
+                f"{_fmt(pred.get('headroom_frac'))}"
+                f"{'' if pred.get('fits') else ' (DOES NOT FIT)'}")
+        for phase, w in memr.get("watermarks", {}).items():
+            used = w.get("used_bytes")
+            lines.append(
+                f"  {phase}: used "
+                f"{'-' if used is None else f'{used / 2**20:.0f}MiB'}"
+                f" live {w.get('live_count', '-')} bufs"
+                + (f", headroom {_fmt(w['headroom_frac'])}"
+                   if w.get("headroom_frac") is not None else ""))
+        peak = memr.get("peak_bytes")
+        lk = memr.get("leak_checks", {})
+        lines.append(
+            f"measured peak {'-' if peak is None else f'{peak / 2**20:.0f}MiB'}"
+            + (f", min headroom {_fmt(memr['headroom_frac_min'])}"
+               if memr.get("headroom_frac_min") is not None else "")
+            + (f"; leak checks {lk.get('total', 0)} "
+               f"({lk.get('failed', 0)} FAILED)" if lk.get("total") else ""))
 
     if report["faults"]:
         lines.append("-- injected faults --")

@@ -253,6 +253,9 @@ class ParallelPlan:
 # virtual test mesh; dp absorbs the remainder on any larger topology).
 # tools/spmd_check.py generates its per-plan matrix FROM this registry —
 # a new plan here is automatically traced, or loudly missing a harness.
+# Scale-preset entries (presets.SCALE_PRESETS, e.g. cub-512) pair a plan
+# with a scaled config geometry; spmd_check excludes them from the
+# per-push matrix and proves their S4 budget under ``--presets``.
 PLAN_REGISTRY = {
     "dp": ParallelPlan("dp"),
     "fsdp": ParallelPlan("fsdp", fsdp=4),
@@ -260,6 +263,9 @@ PLAN_REGISTRY = {
     "sp-ring": ParallelPlan("sp-ring", sp=2, sp_impl="ring"),
     "sp-ulysses": ParallelPlan("sp-ulysses", sp=2, sp_impl="ulysses"),
     "pp": ParallelPlan("pp", pp=2),
+    # the dim-512 scale rung: ZeRO param sharding is what makes ~345M fit
+    # a 16 GiB chip at all (presets.cub512_config is the geometry half)
+    "cub-512": ParallelPlan("cub-512", fsdp=4),
 }
 
 

@@ -1,0 +1,134 @@
+"""Scaled geometry presets — the ROADMAP's model-scale ladder.
+
+One place naming the (config geometry, ParallelPlan) pairs a run can ask
+for by name, so ``train_dalle.py``'s hard-coded CUB block is one preset
+of many and the analysis suite can gate rungs that do not fit a single
+chip.  Three rungs today:
+
+==========  ======  ========  =======================================
+preset      params  geometry  role
+==========  ======  ========  =======================================
+tiny        ~0.04M  dim-32    tests / smoke (chip-free twins)
+cub         ~15M    dim-256   the production CUB-200 run (PR 1..14)
+cub-512     ~345M   dim-512   first scale rung where HBM genuinely
+                              binds: S4 says ~13.2 GiB/device under
+                              fsdp-4 vs v5e-4's 14.4 GiB budget
+==========  ======  ========  =======================================
+
+``cub-512`` is ALSO a :data:`~dalle_pytorch_tpu.parallel.plan.
+PLAN_REGISTRY` entry (fsdp-4 — the ZeRO sharding that makes 345M fit at
+all): registry name and config preset resolve together via
+:data:`SCALE_PRESETS`.  Scale-preset registry entries are excluded from
+``tools/spmd_check.py``'s default per-push matrix (their S4 compile at
+opt0 takes ~8 minutes at dim-512) — ``spmd_check --presets`` runs the
+full S4 HBM proof, and the nightly CI job carries it; contract_check
+covers the cheap half (geometry instantiates, param count in band,
+shardings lower) on every push, and ``tools/graftmem.py`` commits the
+rung's walker-only memory timeline to the perf ledger.
+
+Config factories import jax lazily: ``tools/spmd_check.py`` must set its
+platform env BEFORE anything touches jax, and it imports this module.
+"""
+from __future__ import annotations
+
+#: Param-count acceptance bands (min, max) per preset — contract_check's
+#: cheap chip-free gate that a geometry edit doesn't silently change the
+#: rung's scale class.
+PARAM_BANDS = {
+    "tiny": (0.01e6, 1e6),
+    "cub": (10e6, 25e6),
+    "cub-512": (300e6, 400e6),
+}
+
+
+def tiny_config(**overrides):
+    """Small geometry: seq 24 (divisible by sp=2), heads 4 (divisible by
+    the ulysses sp axis), depth 2 (divisible by pp=2)."""
+    from dalle_pytorch_tpu import DALLEConfig
+
+    base = dict(dim=32, depth=2, heads=4, dim_head=8, num_text_tokens=50,
+                text_seq_len=8, num_image_tokens=32, image_size=64,
+                image_fmap_size=4)
+    base.update(overrides)
+    return DALLEConfig(**base)
+
+
+def cub_config(**overrides):
+    """The production CUB-200 geometry (bench.py::cub200_config shapes)
+    at the checkpoint-eval dtype (f32 activations)."""
+    from dalle_pytorch_tpu import DALLEConfig
+
+    base = dict(dim=256, depth=8, heads=8, dim_head=64,
+                num_text_tokens=7800, text_seq_len=80,
+                num_image_tokens=1024, image_size=256, image_fmap_size=32)
+    base.update(overrides)
+    return DALLEConfig(**base)
+
+
+def cub512_config(**overrides):
+    """The dim-512 scale rung (~345M params): same CUB data geometry
+    (80-token captions, 32x32 code grid), transformer widened to dim-512
+    and deepened to 80 layers — the first rung where the S4 budget
+    genuinely binds (fsdp-4: ~13.2 GiB/device live vs v5e-4's
+    0.9 x 16 GiB) rather than fitting everywhere trivially."""
+    from dalle_pytorch_tpu import DALLEConfig
+
+    base = dict(dim=512, depth=80, heads=8, dim_head=64,
+                num_text_tokens=7800, text_seq_len=80,
+                num_image_tokens=1024, image_size=256, image_fmap_size=32)
+    base.update(overrides)
+    return DALLEConfig(**base)
+
+
+#: Every named config geometry (CLI ``--preset`` surface).
+CONFIG_PRESETS = {
+    "tiny": tiny_config,
+    "cub": cub_config,
+    "cub-512": cub512_config,
+}
+
+#: The scale rungs that are ALSO plan-registry entries: registry name ->
+#: config factory.  tools/spmd_check.py excludes these names from its
+#: default per-push matrix and proves them under ``--presets``.
+SCALE_PRESETS = {
+    "cub-512": cub512_config,
+}
+
+
+def preset_config(name: str, **overrides):
+    """Resolve a preset name to its config (ValueError on unknown)."""
+    if name not in CONFIG_PRESETS:
+        raise ValueError(f"unknown preset {name!r}; known: "
+                         f"{sorted(CONFIG_PRESETS)}")
+    return CONFIG_PRESETS[name](**overrides)
+
+
+def preset_param_count(name: str) -> int:
+    """Chip-free param count of a preset's DALLE (eval_shape — nothing
+    executes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import DALLE
+
+    cfg = preset_config(name)
+    dalle = DALLE(cfg)
+    text = jax.ShapeDtypeStruct((1, cfg.text_seq_len), jnp.int32)
+    codes = jax.ShapeDtypeStruct((1, cfg.image_seq_len), jnp.int32)
+    params = jax.eval_shape(dalle.init, jax.random.PRNGKey(0), text,
+                            codes)["params"]
+    return sum(int(leaf.size) for leaf in jax.tree.leaves(params))
+
+
+def check_param_band(name: str) -> str:
+    """contract_check's preset gate: the param count sits inside the
+    rung's declared band.  Returns the PASS detail; raises ValueError."""
+    lo, hi = PARAM_BANDS[name]
+    n = preset_param_count(name)
+    if not lo <= n <= hi:
+        raise ValueError(
+            f"preset {name!r}: {n / 1e6:.1f}M params outside the declared "
+            f"band [{lo / 1e6:.0f}M, {hi / 1e6:.0f}M] — a geometry edit "
+            "changed the rung's scale class; update presets.PARAM_BANDS "
+            "deliberately if intended")
+    return f"{n / 1e6:.1f}M params in band [{lo / 1e6:.0f}M, {hi / 1e6:.0f}M]"

@@ -54,6 +54,7 @@ from typing import Deque, Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import mem as obs_mem
 from ..obs import metrics as obs_metrics
 from ..obs import telemetry
 from ..utils import faults
@@ -117,7 +118,9 @@ class GenerationServer:
                  seed: int = 0, time_fn=time.monotonic,
                  slo_targets: Optional[Dict[str, float]] = None,
                  tick_sample: int = 1, tel=None,
-                 metrics_labels: Optional[Dict[str, str]] = None):
+                 metrics_labels: Optional[Dict[str, str]] = None,
+                 mem_watermark_ticks: int = 256,
+                 mem_hbm_bytes: Optional[int] = None):
         self.arena = SlotArena(dalle, variables, num_slots,
                                filter_thres=filter_thres, top_p=top_p)
         # tel: an explicit obs.telemetry.Telemetry instance to emit into
@@ -153,6 +156,19 @@ class GenerationServer:
         self._tick_agg = {"ticks": 0, "active_sum": 0,
                           "active_min": None, "active_max": 0,
                           "clock_first": None}
+        # serve-steady memory watermarks: one obs/mem poll per
+        # `mem_watermark_ticks` decode ticks (0 disables).  The tracker
+        # owns the repo's managed polling surface (MEM001); emit=False
+        # because the record must ride THIS server's lane (self._emit),
+        # not the module singleton — and the replica-labeled headroom
+        # gauge is set here so monitor --fleet can print it per replica.
+        # mem_hbm_bytes pins the headroom denominator where the backend
+        # reports no bytes_limit (CPU CI, the chaos rows) — on a real
+        # chip leave it None and the device limit is used.
+        self.mem_watermark_ticks = max(0, int(mem_watermark_ticks))
+        self.mem_tracker = obs_mem.MemTracker(hbm_bytes=mem_hbm_bytes,
+                                              emit=False)
+        self._ticks_since_watermark = 0
         # optional end-to-end latency targets (seconds) per SLO class:
         # when set, each retirement records slo_ok and stats()/obs_report
         # aggregate attainment per class
@@ -484,8 +500,26 @@ class GenerationServer:
                       "cost-model HBM bytes per decoded token",
                       **self._metrics_labels
                       ).set(self.predicted_bytes_per_token)
+        self._ticks_since_watermark += agg["ticks"]
+        if (self.mem_watermark_ticks
+                and self._ticks_since_watermark >= self.mem_watermark_ticks):
+            self._emit_mem_watermark()
         self._tick_agg = {"ticks": 0, "active_sum": 0, "active_min": None,
                           "active_max": 0, "clock_first": None}
+
+    def _emit_mem_watermark(self) -> None:
+        """One serve-steady memory poll: the watermark record rides this
+        server's lane, and the headroom lands as a replica-labeled gauge
+        (the series ``monitor --fleet`` prints beside the predicted byte
+        stream)."""
+        self._ticks_since_watermark = 0
+        rec = self.mem_tracker.snapshot("serve_steady")
+        self._emit("mem", "watermark", **rec)
+        reg = obs_metrics.active()
+        if reg is not None and rec.get("headroom_bytes") is not None:
+            reg.gauge("graft_hbm_headroom_bytes",
+                      "HBM bytes left under the device limit",
+                      **self._metrics_labels).set(rec["headroom_bytes"])
 
     # --- lifecycle: drain / stop -------------------------------------------
 

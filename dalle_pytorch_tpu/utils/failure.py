@@ -229,7 +229,20 @@ class Heartbeat:
         self._last_write = now
         self._write({"step": int(step), "time": time.time(),
                      "process": jax.process_index(),
-                     **self._correlation(), **extra})
+                     **self._correlation(), **self._memory(), **extra})
+
+    @staticmethod
+    def _memory() -> dict:
+        """Compact memory snapshot riding every heartbeat (host RSS +
+        summed device used/peak when the backend exposes counters) — the
+        monitor reads a dying host's memory trajectory from the
+        heartbeat trail alone, no telemetry stream required.  Guarded:
+        a heartbeat must never die because a memory probe did."""
+        try:
+            from dalle_pytorch_tpu.obs import mem
+            return mem.heartbeat_snapshot()
+        except Exception:  # graftlint: disable=EXC001 (liveness signal outranks the memory garnish; heartbeat_snapshot itself guards the backend probe, this catches import-time breakage)
+            return {}
 
     def _sweep_stale_temps(self) -> None:
         """A process killed inside ``_write`` (between mkstemp and the

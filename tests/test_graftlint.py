@@ -466,6 +466,55 @@ def test_obs003_pragma_with_reason_suppresses():
     assert lint_source(src, select=("OBS003",), path="tools/scratch.py") == []
 
 
+# --- MEM001 --------------------------------------------------------------
+
+
+def test_mem001_direct_memory_polls_flagged():
+    """Unmanaged jax device-memory polls produce samples the telemetry
+    stream never hears about (no mem.watermark, no graft_hbm_* gauges,
+    invisible to the leak-gate baseline) — flagged everywhere, trainers
+    and tools included."""
+    src = """
+    import jax
+    def probe(path):
+        blob = jax.profiler.device_memory_profile()
+        n = len(jax.live_arrays())
+        open(path, 'wb').write(blob)
+        return n
+    """
+    for path in ("train_dalle.py", "tools/monitor.py",
+                 "dalle_pytorch_tpu/utils/profiling.py"):
+        assert rules_of(lint(src, select=("MEM001",),
+                             path=path)) == ["MEM001"] * 2, path
+
+
+def test_mem001_mem_module_exempt_and_tracker_clean():
+    """obs/mem.py IS the managed entry point (exempt); call sites using
+    MemTracker / live_buffer_stats are what the rule migrates code
+    toward."""
+    raw = ("import jax\n"
+           "jax.profiler.device_memory_profile()\n"
+           "jax.live_arrays()\n")
+    assert lint_source(raw, select=("MEM001",),
+                       path="dalle_pytorch_tpu/obs/mem.py") == []
+    managed = """
+    from dalle_pytorch_tpu.obs import mem
+    tracker = mem.MemTracker(chip="v4-8")
+    tracker.snapshot("init")
+    mem.live_buffer_stats()
+    mem.write_device_memory_profile("/tmp/x.pprof")
+    """
+    assert lint(managed, select=("MEM001",), path="train_dalle.py") == []
+
+
+def test_mem001_pragma_with_reason_suppresses():
+    src = ("import jax\n"
+           "print(jax.live_arrays())  "
+           "# graftlint: disable=MEM001 (throwaway debugging scratch, no "
+           "telemetry stream attached)\n")
+    assert lint_source(src, select=("MEM001",), path="tools/scratch.py") == []
+
+
 # --- SRV001 --------------------------------------------------------------
 
 
@@ -953,7 +1002,7 @@ def test_every_rule_has_fixture_coverage():
     without positive-fixture coverage fails here."""
     covered = {"ENV001", "SEED001", "BACKEND001", "DOT001", "TRACE001",
                "EXC001", "CKPT001", "OBS001", "OBS002", "OBS003", "SRV001",
-               "DON001", "DON002"}
+               "DON001", "DON002", "MEM001"}
     assert covered == set(RULES)
 
 

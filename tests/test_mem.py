@@ -1,0 +1,541 @@
+"""graftmem contract tests (ISSUE 15 / DESIGN.md §19).
+
+The promises pinned here:
+
+* the peak-live jaxpr walker is EXACT on a program small enough to check
+  by hand (planes + scopes + peak), applies donation credit in the train
+  timeline, and counts an int8 arena's f32 scale planes as real state;
+* the ledger machinery round-trips: memory sub-rows merge under
+  graftprof's fingerprints without clobbering roofline/measured fields,
+  measured watermark history is bounded and survives recomputes;
+* the drift gate goes red on the deliberately-leaking twin (a hoisted
+  full-cache f32 convert fattens the peak) naming the guilty scope, and
+  stays green on identical rows — at the API and at the CLI;
+* the measured side: MemTracker watermarks feed the ``graft_hbm_*``
+  gauges and the ``hbm_headroom`` alert (one pre-OOM sample fires), the
+  obs_report memory section renders the predicted-vs-measured join, and
+  the serve leak gate catches a retire path that stashes cache
+  references while passing a clean server.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.obs import mem, prof
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# --- the peak-live walker ---------------------------------------------------
+
+
+def test_peak_live_matmul_exact():
+    m, k, n = 8, 16, 4
+
+    def step(x, w):
+        with prof.scope("ff"):
+            return x @ w
+
+    x = jax.ShapeDtypeStruct((m, k), jnp.float32)
+    w = jax.ShapeDtypeStruct((k, n), jnp.float32)
+    out = mem.peak_live_fn(step, x, w,
+                           planes=mem.arg_planes(("args", (x, w))))
+    # args persist for the call; at the matmul both operands and the
+    # output are simultaneously live — every byte accounted, by hand
+    assert out["peak_bytes"] == 4 * (m * k + k * n + m * n)
+    assert out["planes"] == {"args": 4 * (m * k + k * n)}
+    assert out["scopes"] == {"ff": 4 * m * n}
+    assert out["resident_bytes"] == 4 * (m * k + k * n)
+
+
+def test_peak_live_scan_does_not_multiply_by_trips():
+    L = 50
+
+    def step(x):
+        def body(c, _):
+            with prof.scope("attn-cache"):
+                return c @ c, None
+
+        y, _ = jax.lax.scan(body, x, None, length=L)
+        return y
+
+    x = jax.ShapeDtypeStruct((16, 16), jnp.float32)
+    out = mem.peak_live_fn(step, x)
+    # the scan reuses its per-trip buffers: peak is one trip's worth of
+    # transients over the carry, nowhere near L x (the flops walker's
+    # multiplication contract is exactly wrong for memory)
+    assert out["peak_bytes"] < 10 * (16 * 16 * 4)
+
+
+def test_tree_bytes_and_arg_planes():
+    tree = {"a": jax.ShapeDtypeStruct((4, 4), jnp.float32),
+            "b": jax.ShapeDtypeStruct((8,), jnp.int8)}
+    assert mem.tree_bytes(tree) == 4 * 16 + 8
+    assert mem.arg_planes(("params", tree), ("args", None)) == [
+        ("params", 2), ("args", 0)]
+
+
+# --- phase timelines --------------------------------------------------------
+
+
+def test_train_phases_donation_credit():
+    compiled = {"argument_bytes": 1000, "output_bytes": 700,
+                "temp_bytes": 300, "donated_bytes": 600}
+    ph = mem.train_phases(compiled)
+    assert ph["init"] == 1000
+    # donated buffers alias outputs into arguments: credited at the peak
+    assert ph["step_peak"] == 1000 + 700 + 300 - 600
+    # the ckpt snapshot pins the old state — the credit is forfeited
+    assert ph["ckpt"] == 1000 + 700 + 300
+    dropped = mem.train_phases(dict(compiled, donated_bytes=0))
+    assert dropped["step_peak"] - ph["step_peak"] == 600
+
+
+def test_analytic_decode_serve_phases_and_headroom():
+    ph = mem.analytic_train_phases(params_bytes=800, opt_bytes=1600,
+                                   walker_peak_bytes=5000,
+                                   resident_bytes=2400, devices=2,
+                                   shard_factor=4)
+    assert ph["init"] == (800 + 1600) // 4
+    assert ph["step_peak"] == ph["init"] + (5000 - 2400) // 2
+    assert ph["ckpt"] == 2 * ph["init"] + (5000 - 2400) // 2
+    assert mem.decode_phases(params_bytes=10, walker_peak_bytes=99) == {
+        "init": 10, "step_peak": 99}
+    assert mem.serve_phases(walker_peak_bytes=7) == {"serve_steady": 7}
+
+    v = mem.headroom_verdict({"init": 2 ** 30, "step_peak": 2 ** 34},
+                             "v4-8")
+    assert v["peak_phase"] == "step_peak"
+    assert v["headroom_bytes"] == prof.CHIP_SPECS["v4-8"].hbm_bytes - 2 ** 34
+    assert v["fits"]  # 16 GiB <= 0.9 x 32 GiB
+    too_big = mem.headroom_verdict({"step_peak": 31 * 2 ** 30}, "v4-8")
+    assert not too_big["fits"]  # inside HBM but over the 0.9 margin
+    with pytest.raises(mem.MemError, match="unknown chip"):
+        mem.headroom_verdict({"init": 1}, "v9-1000")
+
+
+def test_int8_arena_scale_planes_are_arena_state():
+    from dalle_pytorch_tpu import DALLE, DALLEConfig
+    from dalle_pytorch_tpu.serve.engine import SlotArena
+    from dalle_pytorch_tpu.utils.profiling import dalle_decode_cache_bytes
+
+    cfg = DALLEConfig(dim=32, depth=2, heads=4, dim_head=8,
+                      num_text_tokens=50, text_seq_len=8,
+                      num_image_tokens=32, image_size=64, image_fmap_size=4,
+                      kv_cache_int8=True)
+    dalle = DALLE(cfg)
+    slots = 4
+    text = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
+    codes = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
+    variables = jax.eval_shape(dalle.init, jax.random.PRNGKey(0), text,
+                               codes)
+    arena = SlotArena(
+        dalle, jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            variables),
+        num_slots=slots)
+    # the arena's cache subtree carries the int8 payloads AND their f32
+    # scale planes — tree_bytes must agree with the serving cost model
+    # (the rest of arena.state is slot bookkeeping: rng keys, positions)
+    assert mem.tree_bytes(arena.state["caches"]) == dalle_decode_cache_bytes(
+        cfg, slots)
+    leaves = jax.tree.leaves(arena.state["caches"])
+    assert any(leaf.dtype == jnp.int8 for leaf in leaves)
+    assert any(leaf.dtype == jnp.float32 for leaf in leaves)
+    # and the walker attributes the whole plane to `arena` at the peak
+    active = jnp.ones((slots,), bool)
+    write_pos = jnp.int32(0)
+    walk = mem.peak_live(
+        jax.make_jaxpr(arena._tick)(arena.variables, arena.state, active,
+                                    write_pos, arena._qweights),
+        planes=mem.arg_planes(("weights", arena.variables),
+                              ("arena", arena.state),
+                              ("args", (active, write_pos)),
+                              ("weights", arena._qweights)))
+    assert walk["planes"]["arena"] == mem.tree_bytes(arena.state)
+
+
+# --- ledger round trip ------------------------------------------------------
+
+
+def _memrow(peak=1000, scope_bytes=600):
+    phases = {"init": peak // 2, "step_peak": peak, "ckpt": peak}
+    return mem.memory_row(phases=phases,
+                          planes={"params": peak - scope_bytes},
+                          scopes={"ff": scope_bytes},
+                          walker_peak_bytes=peak)
+
+
+def test_upsert_memory_preserves_graftprof_fields(tmp_path):
+    p = tmp_path / "ledger.json"
+    attr = {"scopes": {"ff": {"flops": 10, "bytes": 20}},
+            "unattributed": {"flops": 0, "bytes": 0},
+            "total": {"flops": 10, "bytes": 20},
+            "residual": {"flops": 0.0, "bytes": 0.0}}
+    row = prof.predicted_row(target="t", plan="p", chip="v4-8",
+                             config={"geom": "tiny"}, attr=attr,
+                             roof=prof.roofline(attr, "v4-8"))
+    fp = row["fingerprint"]
+    ledger = prof.load_ledger(p)
+    prof.upsert_predicted(ledger, row)
+    mem.upsert_memory(ledger, fp, _memrow(), target="t", plan="p")
+    prof.save_ledger(ledger, p)
+    again = prof.load_ledger(p)
+    merged = again["rows"][fp]
+    # one row, both tools' fields — graftprof's survive the memory merge
+    assert merged["total"]["flops"] == 10
+    assert merged["roofline"]["bound"] in ("flop", "byte")
+    assert merged["memory"]["phases"]["step_peak"] == 1000
+    # graftprof's own gate ignores memory sub-rows entirely
+    assert prof.diff_ledger(again, {fp: row}) == []
+    # measured memory watermarks append bounded, survive recomputes
+    for i in range(12):
+        mem.append_measured_memory({"phase": "step_peak",
+                                    "used_bytes": 100 + i},
+                                   fingerprint=fp, path=p)
+    final = prof.load_ledger(p)
+    hist = final["rows"][fp]["memory"]["measured"]
+    assert len(hist) == 8 and hist[-1]["used_bytes"] == 111
+    mem.upsert_memory(final, fp, _memrow(peak=2000), target="t", plan="p")
+    assert len(final["rows"][fp]["memory"]["measured"]) == 8
+    assert final["rows"][fp]["memory"]["phases"]["step_peak"] == 2000
+
+
+def test_predicted_memory_for_exact_and_fallback(tmp_path):
+    p = tmp_path / "ledger.json"
+    ledger = prof.load_ledger(p)
+    mem.upsert_memory(ledger, "abcdefabcdef", _memrow(), target="dalle/dp",
+                      plan="dp")
+    prof.save_ledger(ledger, p)
+    exact = mem.predicted_memory_for(fingerprint="abcdefabcdef", path=p)
+    assert exact["exact"] and exact["phases"]["step_peak"] == 1000
+    assert exact["peak_phase"] in ("step_peak", "ckpt")
+    fall = mem.predicted_memory_for(fingerprint="0" * 12, target="dalle/dp",
+                                    plan="dp", path=p)
+    assert fall is not None and not fall["exact"]
+    assert mem.predicted_memory_for(fingerprint="0" * 12, target="nope",
+                                    path=p) is None
+    assert mem.predicted_memory_for(fingerprint="0" * 12,
+                                    path=tmp_path / "absent.json") is None
+
+
+# --- the drift gate vs the leaking twin -------------------------------------
+
+
+def _cache_tick_memrow(leaky: bool) -> dict:
+    """The leaking twin: the broken tick converts the FULL cache to f32
+    (a dtype refactor's classic slip) — the peak fattens by 2x the cache,
+    which is exactly what the memory gate must catch even though the
+    *flops* ledger would shrug at the copy."""
+
+    def tick(cache, x):
+        with prof.scope("attn-cache"):
+            c = jax.lax.dynamic_update_slice(cache, x, (0, 0))
+            # the twin's bug: a full-cache f32 "debug" copy that stays
+            # live across the attention peak
+            dbg = c.astype(jnp.float32) if leaky else None
+        with prof.scope("attn-out"):
+            out = (c.astype(jnp.float32) ** 2).sum()
+        return out + dbg.sum() if leaky else out
+
+    cache = jax.ShapeDtypeStruct((64, 1024), jnp.bfloat16)
+    x = jax.ShapeDtypeStruct((64, 1), jnp.bfloat16)
+    walk = mem.peak_live_fn(tick, cache, x,
+                            planes=mem.arg_planes(("arena", cache),
+                                                  ("args", x)))
+    return mem.memory_row(
+        phases=mem.serve_phases(walker_peak_bytes=walk["peak_bytes"]),
+        planes=walk["planes"], scopes=walk["scopes"],
+        walker_peak_bytes=walk["peak_bytes"])
+
+
+def test_diff_memory_red_on_leaking_twin_green_at_head():
+    good = _cache_tick_memrow(leaky=False)
+    leaky = _cache_tick_memrow(leaky=True)
+    fp = "feedfacecafe"
+    committed = {"v": 1, "rows": {fp: {"fingerprint": fp, "target": "st",
+                                       "plan": "single", "memory": good}}}
+    # identical recompute: green
+    assert mem.diff_memory(committed, {fp: good}) == []
+    problems = mem.diff_memory(committed, {fp: leaky})
+    assert any("serve_steady" in p and "guilty scope" in p
+               for p in problems), problems
+    assert any("attn-cache" in p for p in problems), problems
+    # missing + extra fingerprints both surface
+    assert any("no longer produced" in p
+               for p in mem.diff_memory(committed, {}))
+    assert any("not in the committed ledger" in p
+               for p in mem.diff_memory({"v": 1, "rows": {}}, {fp: good}))
+    # graftprof-only rows and measured-only stubs never gate
+    committed["rows"]["aaaabbbbcccc"] = {
+        "fingerprint": "aaaabbbbcccc", "target": "x",
+        "memory": {"measured": [{"used_bytes": 1}]}}
+    assert mem.diff_memory(committed, {fp: good}) == []
+
+
+def test_graftmem_cli_update_check_and_drift(tmp_path):
+    """The CLI round trip on the walker-only serve row (no compile, so
+    tier-1 fast): --update then --check green, then a fattened committed
+    phase goes red with the guilty scope named and exit 1."""
+    env = {"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+           "HOME": str(tmp_path)}
+    ledger = tmp_path / "ledger.json"
+    base = [sys.executable, str(REPO / "tools" / "graftmem.py"),
+            "--quick", "--targets", "serve-tick", "--ledger", str(ledger)]
+    up = subprocess.run(base + ["--update"], capture_output=True,
+                        text=True, env=env, timeout=300)
+    assert up.returncode == 0, up.stderr
+    assert "serve-tick" in up.stdout
+    check = subprocess.run(
+        base + ["--check", "--json", str(tmp_path / "check.json")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert check.returncode == 0, check.stdout + check.stderr
+    assert "green" in check.stdout
+    doc = json.loads((tmp_path / "check.json").read_text())
+    assert doc["problems"] == [] and doc["rows_checked"] == 1
+    # fatten the committed serve_steady phase by 10%: the gate goes red
+    led = json.loads(ledger.read_text())
+    (fp, row), = ((fp, r) for fp, r in led["rows"].items()
+                  if r.get("target") == "serve-tick")
+    row["memory"]["phases"]["serve_steady"] = int(
+        row["memory"]["phases"]["serve_steady"] * 1.1)
+    ledger.write_text(json.dumps(led))
+    red = subprocess.run(base + ["--check"], capture_output=True,
+                         text=True, env=env, timeout=300)
+    assert red.returncode == 1
+    assert "DRIFT" in red.stdout and "serve_steady" in red.stdout
+    # --report is read-only and renders the committed row
+    rep = subprocess.run(base + ["--report"], capture_output=True,
+                         text=True, env=env, timeout=300)
+    assert rep.returncode == 0 and "serve-tick" in rep.stdout
+
+
+# --- the measured side: tracker, gauges, alert, report ----------------------
+
+
+def test_memtracker_watermark_fields_and_gauges():
+    from dalle_pytorch_tpu.obs.metrics import MetricsRegistry
+
+    tracker = mem.MemTracker(hbm_bytes=1 << 30, emit=False)
+    keep = jnp.zeros((256, 256), jnp.float32)  # a buffer to find
+    rec = tracker.snapshot("init")
+    assert rec["phase"] == "init"
+    assert rec["live_count"] >= 1
+    assert rec["live_bytes"] >= keep.nbytes
+    assert rec["hbm_limit_bytes"] == 1 << 30
+    assert rec["headroom_bytes"] == (1 << 30) - rec["used_bytes"]
+    assert 0.0 < rec["headroom_frac"] <= 1.0
+    # the emit-path feed derives the HBM gauges from the record
+    reg = MetricsRegistry()
+    reg.observe_event(dict(rec, kind="mem", name="watermark"))
+    assert reg.gauge("graft_hbm_used_bytes").value == rec["used_bytes"]
+    assert reg.gauge("graft_hbm_headroom_bytes").value == \
+        rec["headroom_bytes"]
+    rendered = reg.render()
+    assert "graft_hbm_peak_bytes" in rendered
+    with pytest.raises(mem.MemError, match="unknown chip"):
+        mem.MemTracker(chip="v9-1000")
+    assert mem.MemTracker(chip="v5e-4").hbm_bytes == \
+        prof.CHIP_SPECS["v5e-4"].hbm_bytes
+    del keep
+
+
+def test_leak_gate_catches_growth_and_passes_clean():
+    tracker = mem.MemTracker(emit=False)
+    with pytest.raises(mem.MemError, match="before baseline"):
+        tracker.check_baseline()
+    tracker.baseline()
+    # clean churn: allocate and release — back to baseline
+    for _ in range(3):
+        _ = float(jnp.ones((128, 128)).sum())
+    ok = tracker.check_baseline("clean")
+    assert ok["ok"] and ok["count_delta"] <= 0
+    # a stashed reference is a leak
+    stash = [jnp.zeros((64, 64), jnp.float32)]
+    with pytest.raises(mem.LeakError, match="post-warmup baseline"):
+        tracker.check_baseline("stashed")
+    stash.clear()
+
+
+def test_serve_leak_gate_catches_retire_stash():
+    """The deliberately-leaking twin the acceptance gate names: a
+    GenerationServer whose retire path stashes a live copy of the arena
+    cache state per retirement.  The clean server returns to baseline
+    over the same workload; the twin raises LeakError."""
+    from dalle_pytorch_tpu import DALLE, DALLEConfig, VAEConfig
+    from dalle_pytorch_tpu.serve import GenerationServer
+
+    vcfg = VAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                     num_layers=2, hidden_dim=8)
+    cfg = DALLEConfig.from_vae(vcfg, dim=32, num_text_tokens=50,
+                               text_seq_len=6, depth=2, heads=2, dim_head=8,
+                               attn_types=("full",))
+    dalle = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    text = np.asarray(jax.random.randint(rng, (cfg.text_seq_len,), 1, 50),
+                      np.int32)
+    codes = jax.random.randint(rng, (1, cfg.image_seq_len), 0, 32)
+    params = dalle.init(rng, jnp.asarray(text)[None], codes,
+                        return_loss=True)
+
+    class LeakyServer(GenerationServer):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self._stash = []
+
+        def _retire_finished(self):
+            if self._running:
+                # the bug class the gate exists for: a "debug" copy of
+                # live arena cache state kept past retirement
+                self._stash.append(jax.tree.map(jnp.array,
+                                                self.arena.state))
+            super()._retire_finished()
+
+    def drive(server_cls):
+        srv = server_cls(dalle, params, num_slots=2, filter_thres=1.0,
+                         mem_watermark_ticks=0)
+        # warm every entry point first, so jit caches are in baseline
+        srv.submit(text)
+        srv.run_until_idle(max_ticks=300)
+        tracker = srv.mem_tracker
+        tracker.baseline()
+        for _ in range(2):
+            srv.submit(text)
+        srv.run_until_idle(max_ticks=600)
+        try:
+            return tracker.check_baseline(server_cls.__name__)
+        finally:
+            srv.stop()
+
+    assert drive(GenerationServer)["ok"]
+    with pytest.raises(mem.LeakError, match="cache reference"):
+        drive(LeakyServer)
+
+
+def test_scheduler_emits_serve_steady_watermark():
+    """mem_watermark_ticks=1: every flushed tick window polls once and
+    the record rides the server's lane with phase serve_steady."""
+    from dalle_pytorch_tpu import DALLE, DALLEConfig, VAEConfig
+    from dalle_pytorch_tpu.serve import GenerationServer
+
+    vcfg = VAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                     num_layers=2, hidden_dim=8)
+    cfg = DALLEConfig.from_vae(vcfg, dim=32, num_text_tokens=50,
+                               text_seq_len=6, depth=2, heads=2, dim_head=8,
+                               attn_types=("full",))
+    dalle = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    text = np.asarray(jax.random.randint(rng, (cfg.text_seq_len,), 1, 50),
+                      np.int32)
+    codes = jax.random.randint(rng, (1, cfg.image_seq_len), 0, 32)
+    params = dalle.init(rng, jnp.asarray(text)[None], codes,
+                        return_loss=True)
+
+    class _Lane:
+        def __init__(self):
+            self.records = []
+
+        def event(self, kind, name, **fields):
+            self.records.append(dict(kind=kind, name=name, **fields))
+
+        def span(self, kind, name, **fields):
+            import contextlib
+
+            return contextlib.nullcontext()
+
+    lane = _Lane()
+    srv = GenerationServer(dalle, params, num_slots=1, filter_thres=1.0,
+                           tel=lane, mem_watermark_ticks=1,
+                           mem_hbm_bytes=1 << 30)
+    srv.submit(text)
+    srv.run_until_idle(max_ticks=300)
+    srv.stop()
+    marks = [r for r in lane.records
+             if r["kind"] == "mem" and r["name"] == "watermark"]
+    assert marks, "no mem.watermark on the server's lane"
+    assert all(m["phase"] == "serve_steady" for m in marks)
+    assert all(m["hbm_limit_bytes"] == 1 << 30 for m in marks)
+
+
+def test_hbm_headroom_alert_fires_on_one_sample_and_cools_down():
+    from dalle_pytorch_tpu.obs import alerts
+
+    rule = next(r for r in alerts.DEFAULT_RULES if r.name == "hbm_headroom")
+    assert rule.min_count == 1  # one pre-OOM sample must page
+    eng = alerts.AlertEngine(rules=(rule,))
+    fired = []
+    # healthy watermarks: silent
+    for i in range(3):
+        fired += eng.observe({"kind": "mem", "name": "watermark",
+                              "mono": float(i), "seq": i,
+                              "headroom_frac": 0.4})
+    assert fired == []
+    # aged past the window, ONE sample under 5%: fires immediately
+    fired += eng.observe({"kind": "mem", "name": "watermark",
+                          "mono": 500.0, "seq": 3, "headroom_frac": 0.02})
+    assert [a["rule"] for a in fired] == ["hbm_headroom"]
+    assert "OOM" in fired[0]["msg"]
+    # cooldown: a second pre-OOM sample inside 600s stays quiet
+    assert eng.observe({"kind": "mem", "name": "watermark", "mono": 560.0,
+                       "seq": 4, "headroom_frac": 0.01}) == []
+
+
+def test_report_renders_memory_predicted_vs_measured():
+    from dalle_pytorch_tpu.obs.report import build_report, render_text
+
+    events = [
+        {"kind": "mem", "name": "predicted", "run": "r", "host": 0,
+         "t": 1.0, "fingerprint": "abcdefabcdef", "exact": True,
+         "chip": "v4-8",
+         "phases": {"init": 2 ** 30, "step_peak": 3 * 2 ** 30,
+                    "ckpt": 4 * 2 ** 30},
+         "peak_phase": "ckpt", "peak_bytes": 4 * 2 ** 30,
+         "headroom_frac": 0.875, "fits": True},
+        {"kind": "mem", "name": "watermark", "run": "r", "host": 0,
+         "t": 2.0, "phase": "init", "live_count": 10,
+         "live_bytes": 2 ** 30, "used_bytes": 2 ** 30,
+         "peak_bytes": 2 ** 30, "headroom_frac": 0.96},
+        {"kind": "mem", "name": "watermark", "run": "r", "host": 0,
+         "t": 3.0, "phase": "step_peak", "live_count": 22,
+         "live_bytes": 3 * 2 ** 30, "used_bytes": 3 * 2 ** 30,
+         "peak_bytes": 3 * 2 ** 30, "headroom_frac": 0.88},
+        {"kind": "mem", "name": "leak_check", "run": "r", "host": 0,
+         "t": 4.0, "label": "drain", "ok": True, "count_delta": 0,
+         "bytes_delta": 0},
+    ]
+    rep = build_report(events)
+    m = rep["mem"]
+    assert m["predicted"]["peak_phase"] == "ckpt"
+    assert set(m["watermarks"]) == {"init", "step_peak"}
+    assert m["peak_bytes"] == 3 * 2 ** 30
+    assert m["headroom_frac_min"] == 0.88
+    assert m["leak_checks"] == {"total": 1, "failed": 0}
+    text = render_text(rep)
+    assert "memory (predicted vs measured)" in text
+    assert "abcdefabcdef" in text
+    assert "leak checks 1 (0 FAILED)" in text
+    # a run with no mem records renders no memory section
+    bare = build_report([{"kind": "step", "name": "train", "run": "r",
+                          "host": 0, "t": 1.0, "step": 1}])
+    assert bare["mem"] is None
+    assert "memory (predicted" not in render_text(bare)
+
+
+def test_heartbeat_snapshot_rides_beats(tmp_path):
+    from dalle_pytorch_tpu.utils.failure import Heartbeat
+
+    snap = mem.heartbeat_snapshot()
+    # CPU boxes still report host RSS; device fields only with counters
+    assert "rss_mb" in snap and snap["rss_mb"] > 0
+    hb = Heartbeat(tmp_path)
+    hb.beat(3, epoch=0)
+    info = Heartbeat.read(tmp_path / "heartbeat-p0.json")
+    assert info["rss_mb"] > 0
+    hb.close(done=True)

@@ -136,8 +136,12 @@ def test_plan_generates_legacy_shardings(name, tiny_trees):
 def test_spmd_check_matrix_generated_from_registry():
     """tools/spmd_check.py no longer keeps its own plan table: its PLANS
     (mesh kwargs + DALLEConfig overrides) are generated from
-    PLAN_REGISTRY — same keys, same values as the legacy pin above."""
+    PLAN_REGISTRY minus the scale-preset rungs (presets.SCALE_PRESETS,
+    whose S4 compile is a --presets / nightly concern) — and the six
+    canonical plans still match the legacy pin above."""
     import importlib.util
+
+    from dalle_pytorch_tpu.presets import SCALE_PRESETS
 
     spec = importlib.util.spec_from_file_location(
         "spmd_check_cli_plan_test", REPO / "tools" / "spmd_check.py")
@@ -145,11 +149,34 @@ def test_spmd_check_matrix_generated_from_registry():
     spec.loader.exec_module(mod)
     PLANS = mod.PLANS
 
-    assert set(PLANS) == set(PLAN_REGISTRY) == set(LEGACY_PLANS)
+    assert set(PLANS) == set(PLAN_REGISTRY) - set(SCALE_PRESETS)
+    assert set(PLANS) == set(LEGACY_PLANS)
+    assert set(SCALE_PRESETS) <= set(PLAN_REGISTRY)
     for name, spec in PLANS.items():
         assert spec["mesh"] == PLAN_REGISTRY[name].mesh_kwargs()
         assert spec["plan"] == PLAN_REGISTRY[name].config_overrides()
         assert spec == LEGACY_PLANS[name]
+
+
+def test_cub512_preset_registry_and_band():
+    """The cub-512 scale rung: a real PLAN_REGISTRY entry (fsdp-4, the
+    ZeRO sharding that makes ~345M fit a 16 GiB chip), paired with its
+    config preset, with the param count inside the declared band — the
+    cheap chip-free half of the preset gate (spmd_check --presets runs
+    the full S4 proof nightly)."""
+    from dalle_pytorch_tpu import presets
+
+    plan = PLAN_REGISTRY["cub-512"]
+    assert plan.fsdp == 4 and plan.tp == 1 and plan.pp == 1
+    assert ParallelPlan.parse("cub-512") is plan
+    cfg = presets.preset_config("cub-512")
+    assert cfg.dim == 512
+    assert "cub-512" in presets.SCALE_PRESETS
+    # band check at the tiny rung only (eval_shape at dim-512 costs
+    # seconds; the cub-512 band is covered by the slow preset gate)
+    assert "in band" in presets.check_param_band("tiny")
+    with pytest.raises(ValueError, match="unknown preset"):
+        presets.preset_config("nope")
 
 
 def test_pin_update_shardings_reads_the_plan_partitioner(tiny_trees):

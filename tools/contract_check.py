@@ -402,6 +402,46 @@ def check_strategy(name: str, make_cfg=tiny_config, batch: int = 8) -> None:
             f"{dict(mesh.shape)}: {type(e).__name__}: {e}") from e
 
 
+# --- C6: scale presets (the cheap per-push half) -------------------------
+
+
+def check_preset(name: str, batch: int = 8) -> None:
+    """The scale rung (presets.SCALE_PRESETS) instantiates, its param
+    count sits in the declared band, and the rung plan's shardings
+    resolve under AOT lowering — no compile (the full opt0 S4 HBM proof
+    is ``spmd_check --presets``' nightly concern; this is the chip-free
+    gate every push pays, ~15s at dim-512)."""
+    from dalle_pytorch_tpu.parallel.plan import PLAN_REGISTRY
+    from dalle_pytorch_tpu.presets import SCALE_PRESETS, check_param_band
+
+    try:
+        check_param_band(name)
+        plan = PLAN_REGISTRY[name]
+        cfg = SCALE_PRESETS[name](**plan.config_overrides())
+        dalle = DALLE(cfg)
+        pt = plan.partitioner()
+        variables, text = _init_shapes(dalle, batch)
+        codes = _sds((batch, cfg.image_seq_len), jnp.int32)
+        shardings = pt.param_shardings(variables["params"])
+
+        def loss_fn(p, text, codes):
+            return dalle.apply({"params": p}, text, codes,
+                               return_loss=True)
+
+        jax.jit(loss_fn,
+                in_shardings=(shardings, pt.data_sharding,
+                              pt.data_sharding)).lower(
+                    variables["params"], text, codes)
+    except ContractViolation:
+        raise
+    except ValueError as e:
+        raise ContractViolation(str(e)) from e
+    except Exception as e:
+        raise ContractViolation(
+            f"preset {name!r} failed to instantiate/lower: "
+            f"{type(e).__name__}: {e}") from e
+
+
 # --- C5: config variants ------------------------------------------------
 
 PALLAS_TILES = (128, 256, 512)
@@ -465,6 +505,10 @@ def run_all(quick: bool = False) -> int:
     for block in PALLAS_TILES if not quick else PALLAS_TILES[:1]:
         run(f"C5 pallas tiles [block={block}]", check_pallas_variant, block,
             make_cfg)
+    if not quick:
+        from dalle_pytorch_tpu.presets import SCALE_PRESETS
+        for name in sorted(SCALE_PRESETS):
+            run(f"C6 scale preset [{name}]", check_preset, name)
 
     print(f"\ncontract_check: {'FAIL' if failures else 'PASS'} "
           f"({failures} violation(s))")

@@ -12,7 +12,10 @@ when:
   the zero-dropped-futures gate;
 * the router's audit ledger balances with nothing outstanding;
 * every successful result is BIT-IDENTICAL to the single-server
-  greedy reference for its prompt.
+  greedy reference for its prompt;
+* the live-buffer census returns to the post-warmup baseline once the
+  router drains — the serve leak gate (obs/mem.py): a retire/evict
+  path stashing an arena cache reference fails the run, not a pager.
 
 Afterwards the streams replay as one fleet view::
 
@@ -43,6 +46,7 @@ import numpy as np  # noqa: E402
 from dalle_pytorch_tpu import DALLE, DALLEConfig, VAEConfig  # noqa: E402
 from dalle_pytorch_tpu.models.dalle import (decode_codes,  # noqa: E402
                                             prefill_codes)
+from dalle_pytorch_tpu.obs import mem as obs_mem  # noqa: E402
 from dalle_pytorch_tpu.obs import metrics as obs_metrics  # noqa: E402
 from dalle_pytorch_tpu.obs import telemetry  # noqa: E402
 from dalle_pytorch_tpu.serve import (LATENCY, THROUGHPUT,  # noqa: E402
@@ -83,6 +87,8 @@ def main(argv=None) -> int:
                         help="bound on the whole drive (seconds)")
     parser.add_argument("--metrics_port", type=int, default=None,
                         help="optionally serve /metrics while running")
+    parser.add_argument("--no-leak-gate", action="store_true",
+                        help="skip the post-drain live-buffer leak check")
     args = parser.parse_args(argv)
 
     args.out.mkdir(parents=True, exist_ok=True)
@@ -116,6 +122,13 @@ def main(argv=None) -> int:
         probe_every_s=0.2,
         shed_bounds={LATENCY: 10_000, THROUGHPUT: 10_000}).start()
     router.wait_serving(args.replicas, timeout_s=args.timeout)
+    # post-warmup census: every replica has prefilled + decoded once, so
+    # the jit caches and arenas are resident — anything the chaos run
+    # adds on top of THIS is a leak
+    mem_tracker = obs_mem.MemTracker(emit=True)
+    base = mem_tracker.baseline(phase="post-warmup")
+    print(f"[fleet_smoke] leak-gate baseline: {base['live_count']} live "
+          f"buffers / {base['live_bytes']} bytes")
     print(f"[fleet_smoke] {args.replicas} replicas serving; submitting "
           f"{args.requests} requests (kill-tick={args.kill_tick})")
 
@@ -144,6 +157,20 @@ def main(argv=None) -> int:
     audit = router.audit()
     states = {n: r["state"] for n, r in router.stats()["replicas"].items()}
     router.close()
+    # leak gate runs AFTER the router threads stop but BEFORE the
+    # replicas release their arenas: against a baseline that includes
+    # the arenas, a stashed per-request cache reference reads as pure
+    # growth instead of hiding under the freed-arena bytes
+    leak = None
+    if not args.no_leak_gate:
+        try:
+            delta = mem_tracker.check_baseline("fleet-chaos")
+            print(f"[fleet_smoke] leak gate: back to baseline "
+                  f"(count delta {delta['count_delta']}, bytes delta "
+                  f"{delta['bytes_delta']})")
+        except obs_mem.LeakError as e:
+            leak = str(e)
+            print(f"[fleet_smoke] {e}", file=sys.stderr)
     for r in replicas:
         r.close()
     if metrics_server is not None:
@@ -155,7 +182,8 @@ def main(argv=None) -> int:
     print(f"[fleet_smoke] replica states: {states}")
     ok = (dropped == 0 and mismatched == 0 and audit["balanced"]
           and audit["outstanding"] == 0 and audit["resolved_ok"] > 0
-          and (args.kill_tick == 0 or audit["replica_deaths"] >= 1))
+          and (args.kill_tick == 0 or audit["replica_deaths"] >= 1)
+          and leak is None)
     if ok:
         print(f"[fleet_smoke] PASS: zero dropped futures "
               f"({audit['resolved_ok']} ok, {errors} typed errors, "
@@ -164,7 +192,7 @@ def main(argv=None) -> int:
               "results bit-match the single-server path")
         return 0
     print(f"[fleet_smoke] FAIL: dropped={dropped} mismatched={mismatched} "
-          f"audit={audit}", file=sys.stderr)
+          f"leak={'yes' if leak else 'no'} audit={audit}", file=sys.stderr)
     return 1
 
 

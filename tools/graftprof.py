@@ -173,6 +173,36 @@ def _dalle_plan_row(plan: str, make_cfg) -> dict:
                               compiled=compiled)
 
 
+def _cub512_row() -> dict:
+    """The dim-512 scale rung (presets.cub512_config under its fsdp-4
+    registry plan): walker-only — no opt0 compile (dim-512 compiles for
+    ~8 minutes; the full S4 proof is ``spmd_check --presets``' nightly
+    concern), the same carve-out as the decode row.  The memory twin in
+    ``tools/graftmem.py`` gives this rung its binding headroom verdict."""
+    from dalle_pytorch_tpu.presets import cub512_config
+
+    plan = "cub-512"
+    cfg = cub512_config()
+    dalle = DALLE(cfg)
+    tx = make_optimizer(1e-3)
+    text = _sds((TRAIN_BATCH, cfg.text_seq_len), jnp.int32)
+    codes = _sds((TRAIN_BATCH, cfg.image_seq_len), jnp.int32)
+    rng = _sds((2,), jnp.uint32)
+    fs = _sds((), jnp.float32)
+    params = jax.eval_shape(dalle.init, jax.random.PRNGKey(0), text,
+                            codes)["params"]
+    opt = jax.eval_shape(tx.init, params)
+    step = make_dalle_train_step(dalle, tx, health=True)
+    attr = prof.attribute(jax.make_jaxpr(step)(params, opt, None, text,
+                                               codes, rng, fs))
+    target = f"dalle/{plan}"
+    prof.check_coverage(attr, label=target)
+    roof = prof.roofline(attr, CHIP)
+    config = _cfg_payload(cfg, target=target, plan=plan, batch=TRAIN_BATCH)
+    return prof.predicted_row(target=target, plan=plan, chip=CHIP,
+                              config=config, attr=attr, roof=roof)
+
+
 def _vae_cfg(quick: bool) -> VAEConfig:
     if quick:
         return VAEConfig(image_size=16, num_tokens=16, codebook_dim=16,
@@ -320,6 +350,10 @@ def sweep(quick: bool = False, targets_filter=None) -> dict:
     for plan in PLANS:
         builders.append((f"dalle/{plan}",
                          lambda p=plan: _dalle_plan_row(p, make_cfg)))
+    if not quick:
+        # the scale rung rides the full sweep only (its point is the
+        # real dim-512 geometry; a quick twin would fingerprint apart)
+        builders.append(("dalle/cub-512", _cub512_row))
     builders.append(("vae", lambda: _vae_row(quick)))
     builders.append(("clip", lambda: _clip_row(quick)))
     builders.append(("decode", lambda: _decode_row(make_cfg)))

@@ -166,6 +166,12 @@ def scan(directory: Path, timeout: float, expect: int | None,
             for key in ("loss", "grad_norm", "loader_stall_s"):
                 if info.get(key) is not None:
                     detail += f" {key} {float(info[key]):.5g}"
+            # the beat's compact memory snapshot (obs/mem.heartbeat_snapshot
+            # via Heartbeat): a host creeping toward OOM shows its RSS/HBM
+            # trajectory right here, before the stall — no stream parse
+            for key in ("rss_mb", "hbm_used_mb", "hbm_peak_mb"):
+                if info.get(key) is not None:
+                    detail += f" {key} {float(info[key]):.0f}"
             sick = _health_flag(info)
         # graftlint: disable=EXC001 (a heartbeat mid-write is expected; any parse error = torn file, reported as status below)
         except Exception:
@@ -199,7 +205,7 @@ def _scrape_replica_metrics(url: str, timeout: float = 3.0
                             ) -> dict[str, dict]:
     """GET an endpoint's /metrics and fold the per-replica serve series
     into ``{replica: {state, queue: {slo: depth}, occupancy,
-    bytes_per_token}}``.  Only
+    bytes_per_token, hbm_headroom}}``.  Only
     replica-labeled series participate (a single-server trainer's
     unlabeled gauges are not a fleet)."""
     import urllib.request
@@ -234,6 +240,8 @@ def _scrape_replica_metrics(url: str, timeout: float = 3.0
             info["occupancy"] = v
         elif name == "graft_serve_predicted_bytes_per_token":
             info["bytes_per_token"] = v
+        elif name == "graft_hbm_headroom_bytes":
+            info["hbm_headroom"] = v
     return out
 
 
@@ -267,6 +275,12 @@ def _print_replica_metrics(urls: list[str]) -> int:
                 # busy a replica is, this says how heavy each token is
                 bits.append(
                     f"pred {info['bytes_per_token'] / 2**20:.2f} MiB/tok")
+            if info.get("hbm_headroom") is not None:
+                # measured HBM headroom (scheduler watermark gauge) beside
+                # the predicted byte stream: "how heavy is a token" and
+                # "how close is this replica to OOM" read on one line
+                bits.append(
+                    f"hbm headroom {info['hbm_headroom'] / 2**20:.0f} MiB")
             flag = "  << DOWN" if state == "dead" else ""
             print(f"replica {name} [{url}]: {' '.join(bits)}{flag}")
     return bad

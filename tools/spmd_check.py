@@ -74,7 +74,7 @@ enable_compilation_cache()
 import jax.numpy as jnp
 import numpy as np
 
-from dalle_pytorch_tpu import DALLE, DALLEConfig
+from dalle_pytorch_tpu import DALLE
 from dalle_pytorch_tpu.lint import spmd
 from dalle_pytorch_tpu.models.clip import CLIP, CLIPConfig
 from dalle_pytorch_tpu.models.dalle import decode_codes
@@ -108,9 +108,13 @@ HARNESSED_FACTORIES = frozenset(("vae", "dalle", "dalle_sp", "dalle_pp",
 # cannot drift from the production contract (ISSUE 10's single source of
 # truth).  A new registry plan lands here automatically.
 from dalle_pytorch_tpu.parallel.plan import PLAN_REGISTRY
+from dalle_pytorch_tpu.presets import (SCALE_PRESETS, cub512_config,
+                                       cub_config, tiny_config)
 
+# Scale-preset rungs (cub-512: an ~8-minute opt0 compile at dim-512) are
+# excluded from the per-push matrix; ``--presets`` runs their full S4.
 PLANS = {name: dict(mesh=p.mesh_kwargs(), plan=p.config_overrides())
-         for name, p in PLAN_REGISTRY.items()}
+         for name, p in PLAN_REGISTRY.items() if name not in SCALE_PRESETS}
 
 DALLE_ARG_LABELS = ("params", "opt_state", "vae_params", "text", "codes",
                     "rng", "fault_scale")
@@ -120,27 +124,9 @@ CLIP_ARG_LABELS = ("params", "opt_state", "text", "images", "text_mask",
                    "fault_scale")
 
 
-# --- geometries (contract_check's twins) ----------------------------------
-
-
-def tiny_config(**overrides) -> DALLEConfig:
-    """Small geometry: seq 24 (divisible by sp=2), heads 4 (divisible by
-    the ulysses sp axis), depth 2 (divisible by pp=2)."""
-    base = dict(dim=32, depth=2, heads=4, dim_head=8, num_text_tokens=50,
-                text_seq_len=8, num_image_tokens=32, image_size=64,
-                image_fmap_size=4)
-    base.update(overrides)
-    return DALLEConfig(**base)
-
-
-def cub_config(**overrides) -> DALLEConfig:
-    """The production CUB-200 geometry (bench.py::cub200_config shapes) at
-    the checkpoint-eval dtype (f32 activations)."""
-    base = dict(dim=256, depth=8, heads=8, dim_head=64,
-                num_text_tokens=7800, text_seq_len=80,
-                num_image_tokens=1024, image_size=256, image_fmap_size=32)
-    base.update(overrides)
-    return DALLEConfig(**base)
+# --- geometries: tiny_config / cub_config / cub512_config re-exported
+# above from dalle_pytorch_tpu.presets (contract_check's twins; ONE
+# source for every scale rung) -------------------------------------------
 
 
 def _sds(shape, dtype):
@@ -159,7 +145,9 @@ def dalle_step_lowered(plan: str, make_cfg=cub_config, batch: int = 8):
     parallelism plan — health-enabled, donating, input shardings as the
     trainers place them (batch over the data axes, params as the
     Partitioner rules shard them, replicated under shard_map plans)."""
-    spec = PLANS[plan]
+    spec = PLANS.get(plan) or dict(
+        mesh=PLAN_REGISTRY[plan].mesh_kwargs(),
+        plan=PLAN_REGISTRY[plan].config_overrides())
     cfg = make_cfg(**spec["plan"])
     dalle = DALLE(cfg)
     tx = make_optimizer(1e-3)
@@ -500,6 +488,38 @@ def s4_drift_check(plan: str = "dp", make_cfg=cub_config,
             f"{full.output_bytes}, temp drift {drift:.1%}")
 
 
+def run_presets(chip: str = "v5e-4") -> int:
+    """The scale-preset S4 proof (``--presets``): for every
+    presets.SCALE_PRESETS rung, lower the real train step at the rung's
+    geometry under the rung's registry plan and gate the opt0 HBM
+    estimate (with the S2-verified donation credit substituted, the
+    _s4_detail convention) against ``chip``.  Minutes per rung at
+    dim-512 — the nightly CI job's gate, not the per-push matrix;
+    contract_check carries the cheap per-push half (param band +
+    shardings lower)."""
+    from dalle_pytorch_tpu.presets import check_param_band
+
+    failures = 0
+    for name, make_cfg in sorted(SCALE_PRESETS.items()):
+        t0 = time.time()
+        try:
+            band = check_param_band(name)
+            lowered = dalle_step_lowered(name, make_cfg=make_cfg)
+            with spmd.fresh_stats_compile():
+                compiled = lowered.compile(OPT0)
+            detail = _s4_detail(compiled, lowered, chip,
+                                f"preset/{name}@{chip}")
+            print(f"PASS S4-preset [{name}@{chip}] "
+                  f"({time.time() - t0:.0f}s): {band}; {detail}")
+        except (spmd.SPMDViolation, ValueError) as e:
+            failures += 1
+            print(f"FAIL S4-preset [{name}@{chip}] "
+                  f"({time.time() - t0:.0f}s): {e}")
+    print(f"\nspmd_check --presets: {'FAIL' if failures else 'PASS'} "
+          f"({len(SCALE_PRESETS)} rung(s), chip={chip})")
+    return 1 if failures else 0
+
+
 def check_factory_coverage() -> None:
     """The registry/harness sync gate: every training.STEP_FACTORIES entry
     has a harness here, and vice versa."""
@@ -747,9 +767,16 @@ def main(argv=None) -> int:
                              "the scheduled-CI gate that keeps the S4 "
                              "opt-0 shortcut honest across XLA upgrades "
                              "(--quick drops to tiny geometry)")
+    parser.add_argument("--presets", action="store_true",
+                        help="run the scale-preset S4 HBM proof "
+                             "(presets.SCALE_PRESETS, e.g. cub-512) at "
+                             "the rung's real geometry — minutes per "
+                             "rung; the nightly-CI gate")
     args = parser.parse_args(argv)
     if args.selftest:
         return selftest()
+    if args.presets:
+        return run_presets(chip=args.chip)
     if args.s4_drift:
         try:
             detail = s4_drift_check(

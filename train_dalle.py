@@ -36,6 +36,7 @@ from dalle_pytorch_tpu import DALLE, DALLEConfig, DiscreteVAE, VAEConfig
 from dalle_pytorch_tpu.cli import host_fetch, select_tokenizer, enable_compilation_cache
 from dalle_pytorch_tpu.data.dataset import DataLoader, TextImageDataset
 from dalle_pytorch_tpu.models.dalle import generate_codes
+from dalle_pytorch_tpu.obs import mem as obs_mem
 from dalle_pytorch_tpu.obs import prof
 from dalle_pytorch_tpu.obs import telemetry as obs
 from dalle_pytorch_tpu.parallel import backend as distributed_utils
@@ -865,15 +866,22 @@ def _main(argv, lr_scale=1.0, skip_past=None):
         _plan_name = args.run_plan.name
         _prof_target = ('dalle_pp' if pp_mode else
                         'dalle_sp' if sp_plan else 'dalle') + '/' + _plan_name
-        _pred = prof.predicted_for(
-            fingerprint=prof.row_fingerprint({
-                **{k: str(v) for k, v in
-                   sorted(_dc.asdict(dalle_cfg).items())},
-                'target': _prof_target, 'plan': _plan_name,
-                'batch': BATCH_SIZE * jax.process_count()}),
-            target=_prof_target, plan=_plan_name)
+        _fp = prof.row_fingerprint({
+            **{k: str(v) for k, v in
+               sorted(_dc.asdict(dalle_cfg).items())},
+            'target': _prof_target, 'plan': _plan_name,
+            'batch': BATCH_SIZE * jax.process_count()})
+        _pred = prof.predicted_for(fingerprint=_fp, target=_prof_target,
+                                   plan=_plan_name)
         if _pred is not None:
             obs.emit('prof', 'predicted', target=_prof_target, **_pred)
+        # the memory half of the same join (graftmem): the ledger's
+        # predicted HBM timeline for this config, emitted once so
+        # obs_report can set it beside the measured watermarks below
+        _mempred = obs_mem.predicted_memory_for(
+            fingerprint=_fp, target=_prof_target, plan=_plan_name)
+        if _mempred is not None:
+            obs.emit('mem', 'predicted', target=_prof_target, **_mempred)
 
     @jax.jit
     def decode_images(vae_params, codes):
@@ -978,6 +986,9 @@ def _main(argv, lr_scale=1.0, skip_past=None):
             except OSError as e:
                 print(f'[ckpt] managed save at step {step} failed after '
                       f'retries: {e}', file=sys.stderr, flush=True)
+        # the ckpt phase watermark: the host-fetched payload is the
+        # predicted timeline's snapshot term, live right here
+        mem_tracker.snapshot('ckpt', step=step)
 
     from dalle_pytorch_tpu.utils.profiling import StepTimer, dalle_train_flops
 
@@ -985,6 +996,13 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     # peak spans every chip of every process, so feed it global-batch FLOPs
     timer = StepTimer(flops_per_step=dalle_train_flops(
         dalle_cfg, BATCH_SIZE * jax.process_count()))
+    # phase-boundary memory watermarks (obs/mem.py, the managed polling
+    # surface): "init" here — params + opt state resident, no step run
+    # yet — then once per epoch ("step_peak") and after each managed
+    # save ("ckpt"), matching the ledger's predicted phase timeline.
+    # Never per step: live_arrays() walks every buffer in the process.
+    mem_tracker = obs_mem.MemTracker()
+    mem_tracker.snapshot('init', step=start_step)
     lr = sched.lr
     global_step = start_step
     # managed on-chip trace window (steps 10-20 of the first trained
@@ -1250,6 +1268,10 @@ def _main(argv, lr_scale=1.0, skip_past=None):
                     dt = time.perf_counter() - t0
                     print(f'epoch {epoch} done: loss {epoch_loss:.4f} lr {lr:.2e} '
                           f'({dt:.1f}s elapsed)')
+                # steady-state watermark once per epoch: the train-loop
+                # residents (params/opt/prefetch) against the HBM limit
+                mem_tracker.snapshot('step_peak', step=global_step,
+                                     epoch=epoch)
 
             completed = not interrupted
     finally:

@@ -459,14 +459,20 @@ def _main(argv, lr_scale=1.0, skip_past=None):
         import dataclasses as _dc
 
         from dalle_pytorch_tpu.obs import prof
-        _pred = prof.predicted_for(
-            fingerprint=prof.row_fingerprint({
-                **{k: str(v) for k, v in sorted(_dc.asdict(cfg).items())},
-                'target': 'vae', 'plan': 'single',
-                'batch': BATCH_SIZE * jax.process_count()}),
-            target='vae', plan='single')
+        _fp = prof.row_fingerprint({
+            **{k: str(v) for k, v in sorted(_dc.asdict(cfg).items())},
+            'target': 'vae', 'plan': 'single',
+            'batch': BATCH_SIZE * jax.process_count()})
+        _pred = prof.predicted_for(fingerprint=_fp, target='vae',
+                                   plan='single')
         if _pred is not None:
             obs.emit('prof', 'predicted', target='vae', **_pred)
+        # the memory half of the join (graftmem's predicted HBM timeline)
+        from dalle_pytorch_tpu.obs import mem as obs_mem
+        _mempred = obs_mem.predicted_memory_for(
+            fingerprint=_fp, target='vae', plan='single')
+        if _mempred is not None:
+            obs.emit('mem', 'predicted', target='vae', **_mempred)
 
     # jitted eval helpers for the periodic "hard reconstruction" probe
     # (ref train_vae.py:187-209): codebook indices -> decode.
@@ -533,6 +539,9 @@ def _main(argv, lr_scale=1.0, skip_past=None):
             except OSError as e:
                 print(f'[ckpt] managed save at step {step} failed after '
                       f'retries: {e}', file=sys.stderr, flush=True)
+        # ckpt-phase watermark: the host-fetched payload live alongside
+        # the residents is the predicted timeline's snapshot term
+        mem_tracker.snapshot('ckpt', step=step)
 
     global_step = (int(resume_ckpt.get('global_step', 0))
                    if resume_ckpt is not None else 0)
@@ -546,6 +555,11 @@ def _main(argv, lr_scale=1.0, skip_past=None):
     from dalle_pytorch_tpu.utils.profiling import StepTimer
 
     timer = StepTimer()
+    # phase-boundary memory watermarks (obs/mem.py): "init" with params +
+    # opt state resident, "ckpt" after each managed save — never per step
+    from dalle_pytorch_tpu.obs import mem as obs_mem
+    mem_tracker = obs_mem.MemTracker()
+    mem_tracker.snapshot('init', step=global_step)
     # preemption-safe shutdown + stall detection (SURVEY.md §5.3)
     stopper = GracefulShutdown()
     heartbeat = (Heartbeat(args.heartbeat_dir,
