@@ -356,7 +356,8 @@ def make_gen_measure_deferred(batch: int = 8, **overrides):
 
 
 def make_serve_measure(num_slots: int = 64, requests_per_slot: int = 2,
-                       oversubscribe: float = 1.25, **overrides):
+                       oversubscribe: float = 1.25,
+                       prefix_cache: bool = False, **overrides):
     """Compile the continuous-batching generation service
     (serve.GenerationServer over the slot-based KV arena) at the CUB
     geometry; each ``measure()`` drives a synthetic OPEN-LOOP arrival
@@ -371,7 +372,11 @@ def make_serve_measure(num_slots: int = 64, requests_per_slot: int = 2,
     ``gen64`` A/B at ``num_slots=64``.  Per-request p50/p99 latency, slot
     occupancy and the no-recompile sentinel are printed to stderr
     (PERF.md "Serve throughput/latency" row schema).  ``overrides``
-    replace DALLEConfig fields, exactly like ``make_gen_measure``."""
+    replace DALLEConfig fields, exactly like ``make_gen_measure``;
+    ``prefix_cache`` is a SERVER knob (the radix prefix cache lives in
+    the scheduler, not the model config) — every arrival in the trace
+    shares one prompt, so the prefix A/B measures the all-hit admission
+    path (one prefill serves the whole drive)."""
     import dataclasses
 
     import numpy as np
@@ -390,7 +395,7 @@ def make_serve_measure(num_slots: int = 64, requests_per_slot: int = 2,
         r, jnp.asarray(text)[None],
         jnp.zeros((1, cfg.image_seq_len), jnp.int32)))(rng)
     server = GenerationServer(model, params, num_slots=num_slots,
-                              filter_thres=0.9)
+                              filter_thres=0.9, prefix_cache=prefix_cache)
 
     # two closed-loop warm-up passes: the first pays every compile
     # (prefill/admit/tick), the second — compile-warm — calibrates the
@@ -420,8 +425,9 @@ def make_serve_measure(num_slots: int = 64, requests_per_slot: int = 2,
                              max_ticks=4 * n_requests * cfg.image_seq_len)
         dt = time.perf_counter() - t0
         assert stats["failed"] == 0, f"{stats['failed']} serve failures"
+        decode_key = "tick_spec" if cfg.spec_decode else "tick"
         assert stats["trace_counts"] == {
-            "prefill": 1, "admit": 1, "tick": 1}, (
+            "prefill": 1, "admit": 1, decode_key: 1}, (
             f"serve retraced mid-drive: {stats['trace_counts']}")
         lp50, lp99 = stats["latency_p50"], stats["latency_p99"]
         print(f"serve[{num_slots} slots]: occupancy "
@@ -429,6 +435,15 @@ def make_serve_measure(num_slots: int = 64, requests_per_slot: int = 2,
               f"{lp50['throughput']:.2f}s, p99 {lp99['throughput']:.2f}s, "
               f"{stats['completed']} requests, "
               f"{stats['preemptions']} preemptions", file=sys.stderr)
+        if stats.get("prefix"):
+            px = stats["prefix"]
+            print(f"serve prefix cache: hit-rate {px['hit_rate']:.2f} "
+                  f"({px['hits']} hits / {px['misses']} misses), "
+                  f"{px['prefill_flops_saved']:.3g} prefill FLOPs saved",
+                  file=sys.stderr)
+        if stats.get("spec_accepted_k") is not None:
+            print(f"serve spec decode: accepted-K "
+                  f"{stats['spec_accepted_k']:.2f}", file=sys.stderr)
         server.reset()
         return stats["decoded_tokens"] / dt, dt
 

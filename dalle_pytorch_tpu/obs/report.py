@@ -165,6 +165,25 @@ def build_report(events: List[dict]) -> dict:
         int(r["active_sum"]) if r.get("active_sum") is not None
         else int(r.get("active", 0)) * int(r.get("ticks", 1))
         for r in ticks)
+    # spec decode: tick records carry `tokens` (committed this window,
+    # variable under speculation) next to `active_sum` (slot-ticks) —
+    # their ratio is the measured accepted-K the cost model predicts
+    tick_tokens = sum(int(r["tokens"]) for r in ticks
+                      if r.get("tokens") is not None)
+    has_spec = any(r.get("spec") for r in ticks)
+    # prefix cache: one `prefix` record per admission (hit flag +
+    # RUNNING totals) — counts sum, totals read off the LAST record
+    prefix_recs = [r for r in serve if r.get("name") == "prefix"]
+    prefix_report = None
+    if prefix_recs:
+        hits = sum(bool(r.get("hit")) for r in prefix_recs)
+        prefix_report = {
+            "lookups": len(prefix_recs),
+            "hits": hits,
+            "hit_rate": hits / len(prefix_recs),
+            "entries": prefix_recs[-1].get("entries"),
+            "prefill_flops_saved": prefix_recs[-1].get("flops_saved"),
+        }
     serve_report = {
         "submitted": sum(r.get("name") == "submit" for r in serve),
         "completed": len(retires),
@@ -174,6 +193,9 @@ def build_report(events: List[dict]) -> dict:
         "tick_records": len(ticks),
         "occupied_slot_ticks": slot_ticks,
         "decoded_tokens": sum(int(r.get("tokens", 0)) for r in retires),
+        "accepted_k": (tick_tokens / slot_ticks
+                       if has_spec and slot_ticks else None),
+        "prefix": prefix_report,
         "by_class": per_class,
     }
 
@@ -406,6 +428,17 @@ def render_text(report: dict) -> str:
             f"completed / {sv['failed']} failed, preemptions "
             f"{sv['preemptions']}, ticks {sv['ticks']}, tokens "
             f"{sv['decoded_tokens']}")
+        if sv.get("accepted_k") is not None:
+            lines.append(
+                f"  spec decode: accepted-K {_fmt(sv['accepted_k'])} "
+                f"per active slot-tick")
+        pref = sv.get("prefix")
+        if pref:
+            lines.append(
+                f"  prefix cache: {pref['hits']}/{pref['lookups']} hits "
+                f"(rate {_fmt(pref['hit_rate'])}), entries "
+                f"{pref['entries']}, prefill FLOPs saved "
+                f"{_fmt(pref['prefill_flops_saved'])}")
         for slo, row in sv["by_class"].items():
             lines.append(
                 f"  {slo}: n={row['completed']} p50 "

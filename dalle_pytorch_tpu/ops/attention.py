@@ -42,8 +42,8 @@ import numpy as np
 
 from ..obs import prof
 from ..utils.helpers import max_neg_value
-from .quant import (cache_write, circular_slice_in_dim, qdense, scaled_qdot,
-                    split_cache)
+from .quant import (cache_write, cache_write_rows, circular_slice_in_dim,
+                    qdense, scaled_qdot, split_cache)
 
 VARIANTS = ("full", "axial_row", "axial_col", "conv_like", "sparse")
 
@@ -599,7 +599,26 @@ class MultiHeadAttention(nn.Module):
             cache_v = cache_write(cache_v, v, (0, 0, write_pos, 0))
             k_vals, k_scale = split_cache(cache_k)
             v_vals, v_scale = split_cache(cache_v)
+        out = self._aligned_read(q, k_vals, k_scale, v_vals, v_scale,
+                                 idx, r, x.dtype)
+        out = out.transpose(0, 2, 1, 3).reshape(b, 1, self.heads * self.dim_head)
+        return self._out_proj(out, qw), cache_k, cache_v
 
+    def _aligned_read(self, q, k_vals, k_scale, v_vals, v_scale, idx, r,
+                      out_dtype):
+        """The read half of the phase-aligned decode step: one query per
+        row (``q`` [b, heads, 1, dh]) at logical position ``idx`` [b]
+        against row caches rotated by ``r`` [b].  Returns the attended
+        values [b, heads, 1, dh].
+
+        Shared verbatim between :meth:`_decode_step_aligned` (the greedy
+        serve tick) and :meth:`decode_span` (the speculative draft/verify
+        passes, which fold their K span queries into the batch axis) —
+        one program means the two paths consume bitwise-identical masked
+        softmaxes, which is what lets the spec-decode bit-equality tests
+        extend the greedy harness unchanged."""
+        n_k = k_vals.shape[2]
+        scale = self.dim_head ** -0.5
         sliced = (decode_key_positions(self.pattern, jnp.int32(0))
                   if self.sliced_kv_decode else None)
         if sliced is not None:
@@ -649,22 +668,70 @@ class MultiHeadAttention(nn.Module):
                        & valid)[:, None, None, :]
                 dots = jnp.where(row, dots, max_neg_value(dots.dtype))
                 attn = jax.nn.softmax(dots, axis=-1)  # f32
-                out = self._attn_v(attn, v_sub, v_scale, x.dtype)
-        else:
-            with prof.scope("attn-scores"):
-                dots = self._cache_dots(q * scale, k_vals, k_scale)
-                logical = jnp.remainder(
-                    jnp.arange(n_k, dtype=jnp.int32)[None, :] - r[:, None],
-                    n_k)
-                layout = self.pattern.block_layout()
-                row = _allowed(self.pattern, idx[:, None], logical, jnp,
-                               layout=(jnp.asarray(layout)
-                                       if layout is not None else None))
-                dots = jnp.where(row[:, None, None, :], dots,
-                                 max_neg_value(dots.dtype))
-                attn = jax.nn.softmax(dots, axis=-1)  # f32
-                out = self._attn_v(attn, v_vals, v_scale, x.dtype)
-        out = out.transpose(0, 2, 1, 3).reshape(b, 1, self.heads * self.dim_head)
+                return self._attn_v(attn, v_sub, v_scale, out_dtype)
+        with prof.scope("attn-scores"):
+            dots = self._cache_dots(q * scale, k_vals, k_scale)
+            logical = jnp.remainder(
+                jnp.arange(n_k, dtype=jnp.int32)[None, :] - r[:, None],
+                n_k)
+            layout = self.pattern.block_layout()
+            row = _allowed(self.pattern, idx[:, None], logical, jnp,
+                           layout=(jnp.asarray(layout)
+                                   if layout is not None else None))
+            dots = jnp.where(row[:, None, None, :], dots,
+                             max_neg_value(dots.dtype))
+            attn = jax.nn.softmax(dots, axis=-1)  # f32
+            return self._attn_v(attn, v_vals, v_scale, out_dtype)
+
+    def decode_span(self, x, cache_k, cache_v, qpos, rot, valid, qw=None):
+        """K-token span pass with KV cache — the speculative-decode
+        primitive (draft steps run it at K=1 through a depth-limited
+        stack; the verify scores all K positions in one weight-stream
+        pass).
+
+        x: [b, K, dim] embeddings of the span tokens; ``qpos`` [b, K]
+        int32 logical absolute positions (consecutive per row); ``rot``
+        [b] each row's cache rotation ((write_col - index) mod n — zeros
+        for the static sampler, the admit-time rotation in the serve
+        arena); ``valid`` [b, K] bool gates the cache writes (a position
+        past the row's remaining sequence would wrap-write into a live
+        column).  Returns (out [b, K, dim-equivalent], new_k, new_v).
+
+        All K k/v rows are written BEFORE any read, so query j sees its
+        own and every earlier span position's fresh keys; later span
+        positions are causally masked.  The reads fold the K queries into
+        the batch axis and run :meth:`_aligned_read` — the exact program
+        the greedy serve tick reads with — so a span query at position p
+        produces bitwise the same output as a greedy step at p over the
+        same cache (batch-shape invariance of the per-row program, the
+        property the serve bit-equality tests already pin)."""
+        b, K, _ = x.shape
+        q, k, v = self._qkv_decode(x, qw)  # [b, h, K, dh]
+        n_k = split_cache(cache_k)[0].shape[2]
+        idx = qpos.astype(jnp.int32)
+        r = jnp.remainder(jnp.asarray(rot, jnp.int32), n_k)  # [b]
+        phys = jnp.remainder(idx + r[:, None], n_k)          # [b, K]
+        with prof.scope("attn-cache"):
+            cache_k = cache_write_rows(cache_k, k, phys, valid)
+            cache_v = cache_write_rows(cache_v, v, phys, valid)
+            k_vals, k_scale = split_cache(cache_k)
+            v_vals, v_scale = split_cache(cache_v)
+        # fold the span into the batch axis: row (b, j) of the folded
+        # batch is one greedy-shaped query at logical position qpos[b, j]
+        # against (a broadcast view of) row b's cache
+        B = b * K
+        qf = q.transpose(0, 2, 1, 3).reshape(B, self.heads, 1, self.dim_head)
+        idx_f = idx.reshape(B)
+        r_f = jnp.repeat(r, K)
+
+        def fold(a):
+            return None if a is None else jnp.repeat(a, K, axis=0)
+
+        out = self._aligned_read(qf, fold(k_vals), fold(k_scale),
+                                 fold(v_vals), fold(v_scale),
+                                 idx_f, r_f, x.dtype)
+        out = out.transpose(0, 2, 1, 3).reshape(
+            b, K, self.heads * self.dim_head)
         return self._out_proj(out, qw), cache_k, cache_v
 
     @staticmethod

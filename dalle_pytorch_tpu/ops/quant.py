@@ -109,6 +109,35 @@ def cache_write(cache: CacheLike, new, start) -> CacheLike:
     return (updated, scale)
 
 
+def cache_write_rows(cache: CacheLike, new, rows, valid) -> CacheLike:
+    """Write a K-wide span of decode rows into a cache entry of either
+    layout at PER-ROW physical columns — the speculative-decode commit
+    (``ops/attention.py::MultiHeadAttention.decode_span``).
+
+    ``new`` is ``[b, heads, K, dh]``; ``rows`` ``[b, K]`` int32 gives each
+    batch row's K physical cache columns (consecutive logical positions
+    through the row's rotation, so the K indices within a row are always
+    distinct); ``valid`` ``[b, K]`` bool keeps the resident value where
+    False (positions past the row's remaining sequence must not wrap-write
+    into live columns).  Unlike :func:`cache_write` this lowers to a
+    scatter (per-row columns can't share one dynamic_update_slice) — the
+    speculative path amortizes that cost over the K tokens it commits,
+    and the greedy/serve tick keeps the aligned single-column write."""
+    values, scale = split_cache(cache)
+    q = requantize(new, scale, values.dtype)
+    # invalid lanes re-write their current value: a gather+select keeps
+    # the scatter's index set static (distinct within each row), which a
+    # masked index would not
+    cur = jnp.take_along_axis(values, rows[:, None, :, None], axis=2)
+    upd = jnp.where(valid[:, None, :, None], q, cur)
+    b = values.shape[0]
+    updated = values.at[jnp.arange(b)[:, None], :, rows, :].set(
+        upd.transpose(0, 2, 1, 3))
+    if scale is None:
+        return updated
+    return (updated, scale)
+
+
 def scaled_qdot(einsum_spec: str, a, qb, scale=None, *,
                 mul_dtype=jnp.bfloat16):
     """Contraction with an int8 multiplicand: ``a`` (activations /

@@ -19,6 +19,7 @@ riding the rc-74 preemption contract, and an exactly-once future
 resolution audit (zero dropped futures under replica loss).
 """
 from .engine import ArenaGeometry, SlotArena
+from .prefix import RadixPrefixCache
 from .replica import (DEAD, DRAINING, JOINING, SERVING, Replica,
                       ReplicaDown)
 from .router import (FleetRouter, NoHealthyReplica, RequestFailed,
@@ -28,7 +29,8 @@ from .scheduler import (LATENCY, SLO_CLASSES, THROUGHPUT, GenerationServer,
                         ServeHandle, ServerStopped)
 
 __all__ = [
-    "ArenaGeometry", "SlotArena", "GenerationServer", "ServeHandle",
+    "ArenaGeometry", "SlotArena", "RadixPrefixCache", "GenerationServer",
+    "ServeHandle",
     "ServerStopped", "LATENCY", "THROUGHPUT", "SLO_CLASSES",
     "Replica", "ReplicaDown", "JOINING", "SERVING", "DRAINING", "DEAD",
     "FleetRouter", "RouterHandle", "RouterError", "ShedError",

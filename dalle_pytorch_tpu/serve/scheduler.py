@@ -59,6 +59,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import telemetry
 from ..utils import faults
 from .engine import SlotArena
+from .prefix import RadixPrefixCache
 
 LATENCY = "latency"
 THROUGHPUT = "throughput"
@@ -108,6 +109,9 @@ class ServeHandle:
 class _Running:
     handle: ServeHandle
     done: int  # codes decoded so far (admit samples the first)
+    # prompt-token key pinning this request's prefix-cache payload
+    # (None when the cache is off); released on retire/fail/preempt/stop
+    prefix_key: Optional[Tuple[int, ...]] = None
 
 
 class GenerationServer:
@@ -120,9 +124,29 @@ class GenerationServer:
                  tick_sample: int = 1, tel=None,
                  metrics_labels: Optional[Dict[str, str]] = None,
                  mem_watermark_ticks: int = 256,
-                 mem_hbm_bytes: Optional[int] = None):
+                 mem_hbm_bytes: Optional[int] = None,
+                 prefix_cache: bool = False, prefix_capacity: int = 32):
         self.arena = SlotArena(dalle, variables, num_slots,
                                filter_thres=filter_thres, top_p=top_p)
+        # spec_decode (a model-plan flag, default OFF): the scheduler's
+        # only change is variable tokens-per-tick — tick_spec returns each
+        # slot's accepted span length m and `done`/token accounting add m
+        # instead of 1.  SLO/latency math is untouched (it is per-request
+        # wall-clock, not per-tick).
+        self._spec = bool(dalle.cfg.spec_decode)
+        self._spec_committed = 0
+        # prefix_cache (a server knob, default OFF): admissions sharing a
+        # prompt install copies of ONE batch-1 prefill via the refcounted
+        # radix tree — including identical prompts already sitting in the
+        # queue together (the dedupe case: the first admit misses and
+        # inserts, the rest hit before any tick runs).
+        self.prefix: Optional[RadixPrefixCache] = None
+        if prefix_cache:
+            from ..utils.profiling import dalle_prefill_flops
+            self.prefix = RadixPrefixCache(
+                prefix_capacity,
+                prefill_flops=dalle_prefill_flops(dalle.cfg))
+        self.prefill_count = 0  # arena.prefill CALLS (cache hits skip it)
         # tel: an explicit obs.telemetry.Telemetry instance to emit into
         # (a fleet replica's own per-stream lane); None = the module
         # singleton, the single-server deployment shape.  metrics_labels
@@ -153,7 +177,7 @@ class GenerationServer:
         # consumers (obs/report.py) reconstruct totals exactly; partial
         # windows flush when the server drains idle, so nothing is lost.
         self.tick_sample = max(1, int(tick_sample))
-        self._tick_agg = {"ticks": 0, "active_sum": 0,
+        self._tick_agg = {"ticks": 0, "tokens": 0, "active_sum": 0,
                           "active_min": None, "active_max": 0,
                           "clock_first": None}
         # serve-steady memory watermarks: one obs/mem poll per
@@ -328,6 +352,8 @@ class GenerationServer:
                 h.finished_at = self._time()
                 del self._running[slot]
                 self._free.append(slot)
+                if self.prefix is not None and run.prefix_key is not None:
+                    self.prefix.release(run.prefix_key)
                 self.completed.append(h)
                 target = self.slo_targets.get(h.slo)
                 self._emit(
@@ -359,6 +385,8 @@ class GenerationServer:
     def _fail(self, slot: int, exc: BaseException) -> None:
         run = self._running.pop(slot)
         self._free.append(slot)
+        if self.prefix is not None and run.prefix_key is not None:
+            self.prefix.release(run.prefix_key)
         run.handle.finished_at = self._time()
         self.failed.append(run.handle)
         self._emit("serve", "fail", rid=run.handle.request_id, slot=slot,
@@ -377,6 +405,10 @@ class GenerationServer:
         _, slot = min(victims)
         run = self._running.pop(slot)
         self._free.append(slot)
+        if self.prefix is not None and run.prefix_key is not None:
+            # unpin now; the restart's admit re-acquires (likely a hit —
+            # the payload stays resident unless eviction claims it)
+            self.prefix.release(run.prefix_key)
         run.handle.preemptions += 1
         self.preemption_count += 1
         self._emit("serve", "preempt", rid=run.handle.request_id,
@@ -405,9 +437,21 @@ class GenerationServer:
             self._admit(handle)
 
     def _admit(self, handle: ServeHandle) -> None:
-        with self._span("serve", "prefill", rid=handle.request_id):
-            first_logits, caches = self.arena.prefill(
-                jnp.asarray(handle.text))
+        pkey: Optional[Tuple[int, ...]] = None
+        payload = None
+        if self.prefix is not None:
+            pkey = tuple(int(t) for t in handle.text[0])
+            payload = self.prefix.acquire(pkey)
+        hit = payload is not None
+        if payload is None:
+            with self._span("serve", "prefill", rid=handle.request_id):
+                payload = self.arena.prefill(jnp.asarray(handle.text))
+            self.prefill_count += 1
+            if self.prefix is not None:
+                # insert pins for THIS request (and dedupes a racing
+                # identical insert by keeping the resident payload)
+                payload = self.prefix.insert(pkey, payload)
+        first_logits, caches = payload
         slot = self._free.pop()
         # self._clock is the NEXT tick's number — it pins the slot's cache
         # rotation so every later tick writes the shared physical column
@@ -418,6 +462,11 @@ class GenerationServer:
                    slo=handle.slo,
                    queue_wait_s=handle.admitted_at - handle.submitted_at,
                    preemptions=handle.preemptions)
+        if self.prefix is not None:
+            st = self.prefix.stats()
+            self._emit("serve", "prefix", rid=handle.request_id, hit=hit,
+                       entries=st["entries"],
+                       flops_saved=st["prefill_flops_saved"])
         reg = obs_metrics.active()
         if reg is not None:
             with self._lock:
@@ -425,7 +474,25 @@ class GenerationServer:
             reg.gauge("graft_serve_queue_depth",
                       "queued requests awaiting a slot",
                       slo=handle.slo, **self._metrics_labels).set(depth)
-        self._running[slot] = _Running(handle=handle, done=1)
+            if self.prefix is not None:
+                if hit:
+                    reg.counter("graft_serve_prefix_hits_total",
+                                "admissions served from the prefix cache",
+                                **self._metrics_labels).inc()
+                    reg.counter("graft_serve_prefix_flops_saved_total",
+                                "prefill FLOPs avoided by prefix hits",
+                                **self._metrics_labels
+                                ).inc(self.prefix.prefill_flops)
+                else:
+                    reg.counter("graft_serve_prefix_misses_total",
+                                "admissions that ran a fresh prefill",
+                                **self._metrics_labels).inc()
+                reg.gauge("graft_serve_prefix_entries",
+                          "resident prefix-cache payloads",
+                          **self._metrics_labels
+                          ).set(self.prefix.stats()["entries"])
+        self._running[slot] = _Running(handle=handle, done=1,
+                                       prefix_key=pkey)
         self._decoded_tokens += 1  # admit samples the request's first code
 
     def _tick_once(self) -> int:
@@ -449,19 +516,35 @@ class GenerationServer:
         mask = np.zeros((self.num_slots,), bool)
         for slot in advancing:
             mask[slot] = True
-        self.arena.tick(mask, self._clock)
-        self._clock += 1
-        for slot in advancing:
-            self._running[slot].done += 1
+        if self._spec:
+            # speculative tick: each active slot commits its accepted
+            # span (1..spec_k tokens) — progress accounting consumes the
+            # per-slot lengths, everything else (occupancy, SLO math) is
+            # still per-tick/per-request
+            ms = self.arena.tick_spec(mask)
+            self._clock += 1
+            tokens = 0
+            for slot in advancing:
+                adv = int(ms[slot])
+                self._running[slot].done += adv
+                tokens += adv
+            self._spec_committed += tokens
+        else:
+            self.arena.tick(mask, self._clock)
+            self._clock += 1
+            for slot in advancing:
+                self._running[slot].done += 1
+            tokens = len(advancing)
         n = len(advancing)
         self._ticks += 1
         self._occupied_slot_ticks += n
-        self._decoded_tokens += n
+        self._decoded_tokens += tokens
         # one record per `tick_sample` decode ticks (never per slot per
         # tick): occupancy and clock phase land on the timeline without
         # multiplying the stream by num_slots x tick rate
         agg = self._tick_agg
         agg["ticks"] += 1
+        agg["tokens"] += tokens
         agg["active_sum"] += n
         agg["active_min"] = (n if agg["active_min"] is None
                              else min(agg["active_min"], n))
@@ -482,11 +565,21 @@ class GenerationServer:
         self._emit("serve", "tick", clock=self._clock - 1,
                    active=agg["active_sum"] / agg["ticks"],
                    ticks=agg["ticks"], active_sum=agg["active_sum"],
+                   tokens=agg["tokens"],
                    active_min=agg["active_min"],
                    active_max=agg["active_max"],
-                   clock_first=agg["clock_first"])
+                   clock_first=agg["clock_first"],
+                   **({"spec": True} if self._spec else {}))
         reg = obs_metrics.active()
         if reg is not None:
+            if self._spec and agg["active_sum"]:
+                # measured accepted-K over the window: the cost model's
+                # denominator (prof.predicted_spec_speedup), exported so
+                # the A/B stage and monitor can join it live
+                reg.gauge("graft_serve_spec_accepted_k",
+                          "mean committed tokens per active slot-tick",
+                          **self._metrics_labels
+                          ).set(agg["tokens"] / agg["active_sum"])
             reg.gauge("graft_serve_occupancy",
                       "occupied-slot fraction over the last tick window",
                       **self._metrics_labels
@@ -504,8 +597,9 @@ class GenerationServer:
         if (self.mem_watermark_ticks
                 and self._ticks_since_watermark >= self.mem_watermark_ticks):
             self._emit_mem_watermark()
-        self._tick_agg = {"ticks": 0, "active_sum": 0, "active_min": None,
-                          "active_max": 0, "clock_first": None}
+        self._tick_agg = {"ticks": 0, "tokens": 0, "active_sum": 0,
+                          "active_min": None, "active_max": 0,
+                          "clock_first": None}
 
     def _emit_mem_watermark(self) -> None:
         """One serve-steady memory poll: the watermark record rides this
@@ -591,6 +685,8 @@ class GenerationServer:
         for slot in sorted(self._running):
             run = self._running.pop(slot)
             self._free.append(slot)
+            if self.prefix is not None and run.prefix_key is not None:
+                self.prefix.release(run.prefix_key)
             unfinished.append(run.handle)
         for h in unfinished:
             h.finished_at = self._time()
@@ -659,6 +755,13 @@ class GenerationServer:
             latency_p99={slo: pct(lat[slo], 99) for slo in SLO_CLASSES},
             slo_attainment={slo: attainment(slo) for slo in SLO_CLASSES},
             trace_counts=self.trace_counts(),
+            prefill_count=self.prefill_count,
+            **({"spec_accepted_k": (
+                self._spec_committed / self._occupied_slot_ticks
+                if self._occupied_slot_ticks else None)}
+               if self._spec else {}),
+            **({"prefix": self.prefix.stats()}
+               if self.prefix is not None else {}),
         )
 
     def reset(self) -> None:
@@ -674,3 +777,5 @@ class GenerationServer:
         self._ticks = 0
         self._occupied_slot_ticks = 0
         self._decoded_tokens = 0
+        self._spec_committed = 0
+        self.prefill_count = 0

@@ -90,6 +90,26 @@ def dalle_train_flops(cfg, batch: int) -> float:
         vocab=cfg.total_tokens, batch=batch, logits_flops=logits_fwd)
 
 
+def dalle_prefill_flops(cfg) -> float:
+    """Analytic forward FLOPs of ONE batch-1 prompt prefill (the
+    ``text_seq_len + 1`` prompt positions through the stack, attention
+    counted dense, plus the single-position logits head) — what a
+    radix-prefix-cache hit SAVES (serve/prefix.py accounts hits in these
+    units so /metrics and obs_report can state the avoided work in a
+    hardware-meaningful number rather than a raw hit count)."""
+    n = cfg.text_seq_len + 1
+    inner = cfg.heads * cfg.dim_head
+    per_layer = (
+        2 * n * cfg.dim * (3 * inner)        # qkv projection
+        + 2 * n * n * inner * 2              # scores + attn·v
+        + 2 * n * inner * cfg.dim            # output projection
+        + 2 * n * cfg.dim * (4 * cfg.dim * 2)    # GEGLU in
+        + 2 * n * (4 * cfg.dim) * cfg.dim        # ff out
+    )
+    head = 2.0 * cfg.dim * cfg.total_tokens  # first-image-token logits
+    return float(cfg.depth * per_layer + head)
+
+
 def dalle_decode_cache_bytes(cfg, batch: int) -> int:
     """Bytes of KV-cache state one decode step carries (each of depth x
     (k, v) caches at [batch, heads, seq_len, dim_head]) — the decode

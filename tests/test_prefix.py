@@ -1,0 +1,228 @@
+"""Cross-request radix prefix cache tests (serve/prefix.py + the
+scheduler's admit-through-cache path, ISSUE 16).
+
+The load-bearing properties:
+
+* **Refcount safety** — no entry is ever freed while a request holds it:
+  eviction only considers refcount==0 entries, and a cache over capacity
+  with every entry pinned simply stays over capacity until releases land.
+* **Exact reuse** — an admission served from the cache is a COPY of the
+  prefill payload (`broadcast_prefill`), so a cache-hit request produces
+  bit-identical codes to a cache-miss request of the same prompt.
+* **One prefill per unique prompt** — two identical prompts admitted
+  through the cache (queued together or back-to-back) run exactly one
+  prefill; the scheduler's `prefill_count` is the acceptance criterion.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu import DALLE, DALLEConfig, VAEConfig
+from dalle_pytorch_tpu.models.dalle import decode_codes, prefill_codes
+from dalle_pytorch_tpu.serve import GenerationServer, RadixPrefixCache
+
+
+# --- RadixPrefixCache unit tests (no jax, payloads are plain objects) ------
+
+
+def test_acquire_miss_then_insert_then_hit():
+    c = RadixPrefixCache(capacity=4)
+    assert c.acquire((1, 2, 3)) is None
+    c.insert((1, 2, 3), "payload-a")
+    assert c.acquire((1, 2, 3)) == "payload-a"
+    s = c.stats()
+    assert s["hits"] == 1 and s["misses"] == 1
+    assert s["hit_rate"] == 0.5
+    assert s["entries"] == 1 and s["pinned"] == 1
+
+
+def test_exact_match_only_no_mid_edge_hits():
+    """The serve admission needs the WHOLE prompt's payload: a walk that
+    ends mid-edge or at an entry-less interior node is a miss, even
+    though the tokens are a prefix of a resident key."""
+    c = RadixPrefixCache(capacity=4)
+    c.insert((1, 2, 3, 4), "abcd")
+    c.insert((1, 2, 9, 9), "ab99")  # splits the (1,2,3,4) edge at (1,2)
+    assert c.acquire((1, 2)) is None          # interior node, no entry
+    assert c.acquire((1, 2, 3)) is None       # mid-edge
+    assert c.acquire((1, 2, 3, 4)) == "abcd"  # exact keys still resolve
+    assert c.acquire((1, 2, 9, 9)) == "ab99"
+
+
+def test_insert_is_idempotent_and_pins_resident_payload():
+    """Two requests racing the same miss both prefill; the second insert
+    keeps the resident payload (the one other requests may already hold)
+    and pins it for the caller."""
+    c = RadixPrefixCache(capacity=4)
+    c.insert((5, 6), "first")
+    c.insert((5, 6), "second")
+    assert c.acquire((5, 6)) == "first"
+    s = c.stats()
+    assert s["entries"] == 1 and s["pinned"] == 1  # refcounts: 2+1 held
+
+
+def test_no_entry_freed_while_referenced():
+    """ISSUE 16 satellite gate: fill past capacity with every entry
+    pinned — NOTHING is evicted (over-capacity while referenced is the
+    safe state); releases then trigger LRU eviction of unpinned entries
+    only, never a held one."""
+    c = RadixPrefixCache(capacity=2)
+    for i in range(4):
+        c.insert((i, i), f"p{i}")          # all pinned (refcount 1)
+    assert c.stats()["entries"] == 4        # over capacity, all held
+    assert c.stats()["evictions"] == 0
+    c.release((0, 0))
+    c.release((2, 2))                       # two unpinned -> evicted (LRU)
+    s = c.stats()
+    assert s["entries"] == 2 and s["evictions"] == 2
+    assert c.acquire((1, 1)) == "p1"        # held entries survived
+    assert c.acquire((3, 3)) == "p3"
+    assert c.acquire((0, 0)) is None        # the released ones are gone
+    assert c.acquire((2, 2)) is None
+
+
+def test_lru_eviction_order_tracks_last_use():
+    c = RadixPrefixCache(capacity=2)
+    c.insert((1,), "a")
+    c.insert((2,), "b")
+    c.release((1,))
+    c.release((2,))
+    assert c.acquire((1,)) == "a"           # refresh (1,): (2,) is now LRU
+    c.release((1,))
+    c.insert((3,), "c")                     # over capacity -> evict (2,)
+    c.release((3,))
+    assert c.acquire((2,)) is None
+    assert c.acquire((1,)) == "a"
+    assert c.acquire((3,)) == "c"
+
+
+def test_release_underflow_asserts():
+    c = RadixPrefixCache(capacity=2)
+    c.insert((7,), "x")
+    c.release((7,))
+    with pytest.raises(AssertionError):
+        c.release((7,))
+
+
+def test_radix_tree_recompresses_after_removal():
+    """Removing a leaf merges single-child chains back into one edge —
+    the path-compression invariant holds through insert/evict cycles."""
+    c = RadixPrefixCache(capacity=1)
+    c.insert((1, 2, 3), "long")
+    c.insert((1, 2), "short")               # splits the edge
+    c.release((1, 2, 3))                    # over capacity -> evict leaf
+    s = c.stats()
+    assert s["entries"] == 1 and s["evictions"] == 1
+    assert c.acquire((1, 2)) == "short"     # the merged tree still resolves
+    assert c.acquire((1, 2, 3)) is None
+
+
+def test_prefill_flops_saved_counter():
+    c = RadixPrefixCache(capacity=4, prefill_flops=100.0)
+    c.insert((1,), "a")
+    c.acquire((1,))
+    c.acquire((1,))
+    assert c.stats()["prefill_flops_saved"] == 200.0
+
+
+# --- scheduler: admit-through-cache ----------------------------------------
+
+
+VCFG = VAEConfig(image_size=16, num_tokens=32, codebook_dim=16, num_layers=2,
+                 hidden_dim=8)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """A 2-layer model + greedy references, just big enough to prove the
+    cache-hit admission path is exact."""
+    cfg = DALLEConfig.from_vae(
+        VCFG, dim=32, num_text_tokens=50, text_seq_len=6, depth=2, heads=2,
+        dim_head=8, attn_types=("full", "axial_row"))
+    dalle = DALLE(cfg)
+    rng = jax.random.PRNGKey(0)
+    texts = [np.asarray(jax.random.randint(
+        jax.random.PRNGKey(i), (cfg.text_seq_len,), 1, 50), np.int32)
+        for i in range(2)]
+    codes = jax.random.randint(rng, (1, cfg.image_seq_len), 0, 32)
+    params = dalle.init(rng, jnp.asarray(texts[0])[None], codes,
+                        return_loss=True)
+    prefill = jax.jit(lambda p, t: prefill_codes(dalle, p, t))
+
+    def greedy_ref(i):
+        fl, caches = prefill(params, jnp.asarray(texts[i])[None])
+        return np.asarray(decode_codes(
+            dalle, params, fl, caches, jax.random.PRNGKey(7),
+            filter_thres=1.0))[0]
+
+    return cfg, dalle, params, texts, [greedy_ref(i) for i in range(2)]
+
+
+def test_two_identical_prompts_one_prefill_and_exact(tiny):
+    """ISSUE 16 acceptance gate: two identical queued prompts admitted
+    through the prefix cache run EXACTLY ONE prefill, both complete
+    bit-identical to the static greedy sampler, and a later identical
+    submit (after both retired) still reuses the retained payload."""
+    _, dalle, params, texts, refs = tiny
+    srv = GenerationServer(dalle, params, num_slots=2, filter_thres=1.0,
+                           prefix_cache=True)
+    h0 = srv.submit(texts[0])
+    h1 = srv.submit(texts[0])               # identical, queued together
+    srv.run_until_idle(max_ticks=200)
+    np.testing.assert_array_equal(h0.result(0), refs[0])
+    np.testing.assert_array_equal(h1.result(0), refs[0])
+    stats = srv.stats()
+    assert stats["prefill_count"] == 1      # ONE prefill served both
+    assert stats["prefix"]["hits"] == 1
+    assert stats["prefix"]["misses"] == 1
+    assert stats["prefix"]["pinned"] == 0   # both retired: nothing held
+    assert stats["prefix"]["prefill_flops_saved"] > 0
+
+    h2 = srv.submit(texts[0])               # retained entry, third request
+    srv.run_until_idle(max_ticks=200)
+    np.testing.assert_array_equal(h2.result(0), refs[0])
+    assert srv.stats()["prefill_count"] == 1
+
+    h3 = srv.submit(texts[1])               # different prompt: real prefill
+    srv.run_until_idle(max_ticks=200)
+    np.testing.assert_array_equal(h3.result(0), refs[1])
+    stats = srv.stats()
+    assert stats["prefill_count"] == 2
+    assert stats["prefix"]["entries"] == 2
+    assert srv.trace_counts() == {"prefill": 1, "admit": 1, "tick": 1}
+
+
+def test_prefix_cache_off_by_default(tiny):
+    _, dalle, params, texts, _ = tiny
+    srv = GenerationServer(dalle, params, num_slots=2, filter_thres=1.0)
+    srv.submit(texts[0])
+    srv.submit(texts[0])
+    srv.run_until_idle(max_ticks=200)
+    stats = srv.stats()
+    assert stats["prefill_count"] == 2      # no cache: every prompt prefills
+    assert "prefix" not in stats
+
+
+def test_preempted_request_releases_its_pin(tiny):
+    """A throughput-class preemption re-queues the request; its prefix
+    pin is released on preempt and re-acquired at re-admission — the
+    refcount stays balanced and the restart is exact."""
+    from dalle_pytorch_tpu.serve import LATENCY, THROUGHPUT
+
+    _, dalle, params, texts, refs = tiny
+    srv = GenerationServer(dalle, params, num_slots=1, filter_thres=1.0,
+                           prefix_cache=True)
+    a = srv.submit(texts[0], slo=THROUGHPUT)
+    srv.step()
+    srv.step()
+    lat = srv.submit(texts[1], slo=LATENCY)  # preempts the fill
+    srv.run_until_idle(max_ticks=400)
+    assert srv.preemption_count == 1
+    np.testing.assert_array_equal(a.result(0), refs[0])
+    np.testing.assert_array_equal(lat.result(0), refs[1])
+    stats = srv.stats()
+    assert stats["prefix"]["pinned"] == 0   # every pin released
+    # the preempted prompt's payload stayed cached: its restart was a hit
+    assert stats["prefill_count"] == 2
+    assert stats["prefix"]["hits"] == 1

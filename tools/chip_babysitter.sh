@@ -29,9 +29,9 @@ cd "$(dirname "$0")/.."
 # re-armed queue whose stage COMMANDS changed can never be skipped by a
 # stale marker from an older queue definition — bump QV whenever any
 # stage's command line changes.
-QV=11
+QV=12
 
-STAGES="gen_bf16_ab gen_int8_ab gen_fused_ab ab_cand bench xprof_capture gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap bench_serve"
+STAGES="gen_bf16_ab gen_int8_ab gen_spec_ab serve_prefix_ab gen_fused_ab ab_cand bench xprof_capture gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap bench_serve"
 
 # Overridable knobs so tests/test_babysitter.py can drive the REAL script
 # (fake python on PATH, private marker dir, second-scale sleeps) without
@@ -271,6 +271,15 @@ run_stage gen_bf16_ab 2400 python tools/perf_ab.py gen_bf16 gen_f32cache --reps 
 # of the ≤0.55x compiler gate (tests/test_perf_model.py) and the C2/C3
 # no-dequant contracts, queued directly behind its bf16 control
 run_stage gen_int8_ab 2400 python tools/perf_ab.py gen_int8 gen_bf16 --reps 2
+# graftspec self-speculative decode (ISSUE 16): shallow-exit drafts + one
+# K-wide verify per iteration vs the greedy sampler — the wall-clock side
+# of graftprof's predicted-speedup row (accepted-K / stream-overhead);
+# bit-equality is the tier-1 gate, this stage is the speed claim
+run_stage gen_spec_ab 2400 python tools/perf_ab.py gen_spec gen --reps 2
+# cross-request radix prefix cache on the 64-slot arena (ISSUE 16): the
+# open-loop trace shares one prompt, so this measures the all-hit
+# admission path (one prefill per drive) vs serve64's per-request prefill
+run_stage serve_prefix_ab 2400 python tools/perf_ab.py serve_prefix serve64 --reps 2
 # fused generate→VAE-decode→CLIP-rerank pipeline wall-clock (genrank
 # rank_codes: shared prefill + zero disk round-trips), images-ranked/sec
 run_stage gen_fused_ab 1800 python tools/perf_ab.py gen_fused_rank --reps 2

@@ -100,8 +100,18 @@ VARIANTS = {
 # control is gen_bf16 (same dtype, bf16 cache, f32 weights).
 # serve_int8: the same recipe on the 64-slot serve arena (per-SLOT scale
 # planes, int8 weight args on every tick) vs serve64's bf16 arena.
+# gen_spec: graftspec's self-speculative sampler (shallow-exit drafts from
+# the first spec_draft_depth blocks + one K-wide full-model verify per
+# iteration) vs the greedy scan — A/B control is `gen` (same batch 8).
+# serve_spec: the same lever on the 64-slot arena (tick_spec: variable
+# tokens-per-tick commits) vs serve64's greedy ticks.
+# serve_prefix: the cross-request radix prefix cache on the 64-slot arena —
+# the open-loop trace shares ONE prompt across every arrival, so this
+# measures the all-hit admission path (one prefill serves the whole
+# drive); control is serve64 (same arena, cache off).
 EXTRAS = ("gen", "gen64", "vae", "gen-dense", "gen_bf16", "gen_f32cache",
-          "gen_fused_rank", "serve64", "serve16", "gen_int8", "serve_int8")
+          "gen_fused_rank", "serve64", "serve16", "gen_int8", "serve_int8",
+          "gen_spec", "serve_spec", "serve_prefix")
 
 
 def main(argv=None) -> int:
@@ -175,19 +185,39 @@ def main(argv=None) -> int:
             # the traced config — A/B control is gen_bf16
             measures[name] = gen_measure(
                 8, dtype=jnp.float32, kv_cache_int8=True, weights_int8=True)
+        elif name == "gen_spec":
+            # graftspec's self-speculative sampler: drafts from the first
+            # spec_draft_depth blocks, one K-wide verify per iteration —
+            # the choice rides the traced config, control is `gen`
+            compile_fn, cfg = bench.make_gen_measure_deferred(
+                batch=8, spec_decode=True)
+            ledger_info[name] = bench.ledger_keys(
+                cfg, target="decode-spec", plan="single", batch=8)
+            measures[name] = compile_fn()
         elif name == "gen_fused_rank":
             measures[name] = bench.make_fused_rank_measure(batch=8)
-        elif name in ("serve64", "serve16", "serve_int8"):
+        elif name in ("serve64", "serve16", "serve_int8", "serve_spec",
+                      "serve_prefix"):
             # serve_int8: the quantized 64-slot arena (per-slot scale
-            # planes, int8 weight args per tick) vs serve64's bf16 arena
+            # planes, int8 weight args per tick) vs serve64's bf16 arena.
+            # serve_spec: tick_spec's variable tokens-per-tick commits vs
+            # serve64's greedy ticks.  serve_prefix: the radix prefix
+            # cache's all-hit admission path (one shared prompt) — a
+            # SERVER knob, not a config field, so it rides the ledger
+            # fingerprint as an extra key instead of the traced config.
             slots = 16 if name == "serve16" else 64
             ov = (dict(kv_cache_int8=True, weights_int8=True)
-                  if name == "serve_int8" else {})
+                  if name == "serve_int8"
+                  else dict(spec_decode=True) if name == "serve_spec"
+                  else {})
+            prefix = name == "serve_prefix"
+            target = "serve-spec" if name == "serve_spec" else "serve-tick"
             ledger_info[name] = bench.ledger_keys(
                 dataclasses.replace(bench.cub200_config(), **ov),
-                target="serve-tick", plan="single", batch=slots,
-                num_slots=slots)
-            measures[name] = bench.make_serve_measure(num_slots=slots, **ov)
+                target=target, plan="single", batch=slots,
+                num_slots=slots, **({"prefix_cache": True} if prefix else {}))
+            measures[name] = bench.make_serve_measure(
+                num_slots=slots, prefix_cache=prefix, **ov)
         elif name == "vae":
             measures[name] = bench.make_vae_measure()
             ledger_info[name] = bench.ledger_keys(
