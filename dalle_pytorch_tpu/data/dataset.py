@@ -23,6 +23,7 @@ import numpy as np
 
 from ..obs import telemetry
 from ..utils import faults
+from ..utils import locks
 
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
 
@@ -158,7 +159,7 @@ class TextImageDataset:
         # a pod-scale job over one unreadable JPEG — but a *rotten* dataset
         # must still fail loudly, so the quarantine is capped.
         self._quarantined: set = set()
-        self._quarantine_lock = threading.Lock()
+        self._quarantine_lock = locks.TracedLock("dataset.quarantine")
         self.max_quarantine = max(8, len(keys) // 20)
 
     def __len__(self):

@@ -17,10 +17,12 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils import locks
+
 _NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "libdalle_host.so"
 
-_lock = threading.Lock()
+_lock = locks.TracedLock("native.load")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

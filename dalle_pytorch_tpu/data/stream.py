@@ -54,6 +54,7 @@ import numpy as np
 
 from ..obs import telemetry
 from ..utils import faults
+from ..utils import locks
 from ..utils.helpers import atomic_write_json
 from .dataset import DataLoader, IMAGE_EXTS, center_crop_resize, make_pair
 
@@ -192,13 +193,13 @@ class ShardStreamDataset:
         self.image_only = image_only
         self.seed = seed
         self._fds: dict = {}
-        self._fd_lock = threading.Lock()
+        self._fd_lock = locks.TracedLock("stream.fds")
         # shard-granular quarantine, mirroring TextImageDataset's per-sample
         # policy: skip what keeps failing, but a rotten shard SET must still
         # fail loudly — the cap is on shards, not samples, because one bad
         # shard takes all of its samples with it.
         self._quarantined: set = set()
-        self._quarantine_lock = threading.Lock()
+        self._quarantine_lock = locks.TracedLock("stream.quarantine")
         self.max_quarantine = max(1, len(self.index.shards) // 20)
 
     def __len__(self):

@@ -790,6 +790,74 @@ def _don002_stmt(stmt: ast.AST, donating, loads, msg) -> Iterator[RuleHit]:
                 yield node, msg.format(name, pos, min(later))
 
 
+# --- THR001/THR002: thread discipline (the AST side of graftrace) ---------
+
+_THR_LOCK_CTORS = frozenset(("Lock", "RLock", "Condition"))
+
+
+def rule_thr001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """Raw ``threading.Lock/RLock/Condition`` construction outside
+    ``utils/locks.py`` bypasses the graftrace witness: that lock's
+    acquisitions never land in the order graph or the contention stats,
+    so the chaos suites can no longer prove the fleet deadlock-free.
+    Construct through ``locks.TracedLock/TracedRLock/TracedCondition``
+    (drop-in, free when the witness is disarmed).  ``threading.Event`` is
+    fine — events carry no ordering.  Fixture files (``*_fixtures.py``)
+    are exempt: their raw locks are the analyzer's test subjects."""
+    msg = ("raw threading.{}() bypasses the graftrace lock-order witness; "
+           "construct via utils.locks.Traced{} (same semantics, witness "
+           "sees it) or pragma with why this lock must stay untraced")
+    norm = ctx.path.replace("\\", "/")
+    if norm.endswith("utils/locks.py") or norm.endswith("_fixtures.py"):
+        return
+    from_imports = set()
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            from_imports.update(a.asname or a.name for a in node.names)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        name = chain.split(".")[-1]
+        if name not in _THR_LOCK_CTORS:
+            continue
+        if chain == f"threading.{name}" or (chain == name
+                                            and name in from_imports):
+            yield node, msg.format(name, name)
+
+
+def rule_thr002(ctx: FileCtx) -> Iterator[RuleHit]:
+    """A ``while`` loop that polls shared state with ``time.sleep`` under
+    ``dalle_pytorch_tpu/serve/`` burns its poll interval on every state
+    change it is waiting for — and worse, never wakes early for shutdown,
+    so a close() racing the loop waits out the full interval (or hangs,
+    if the condition can no longer become true).  Wait on a
+    ``threading.Event``/``Condition`` instead (``stop_evt.wait(dt)`` is
+    the drop-in form: same pacing, immediate wakeup on close).  Pragma
+    the open-loop cases that pace against a local clock rather than
+    shared state."""
+    msg = ("while-loop polls with sleep() in serve/: sleeps never wake "
+           "early for close/stop and add a full interval of latency per "
+           "state change; wait on an Event/Condition "
+           "(e.g. stop_evt.wait(dt)) or pragma with why this loop paces "
+           "a local clock, not shared state")
+    parts = tuple(ctx.path.replace("\\", "/").split("/"))
+    if "dalle_pytorch_tpu" not in parts:
+        return
+    sub = parts[parts.index("dalle_pytorch_tpu") + 1:]
+    if not sub or sub[0] != "serve":
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.While):
+            continue
+        for inner in _walk_skip_defs(node):
+            if isinstance(inner, ast.Call) \
+                    and _attr_chain(inner.func).split(".")[-1] == "sleep" \
+                    and _attr_chain(inner.func) in ("time.sleep", "sleep"):
+                yield inner, msg
+                break
+
+
 RULES = {
     "ENV001": rule_env001,
     "SEED001": rule_seed001,
@@ -803,6 +871,8 @@ RULES = {
     "OBS003": rule_obs003,
     "MEM001": rule_mem001,
     "SRV001": rule_srv001,
+    "THR001": rule_thr001,
+    "THR002": rule_thr002,
     "DON001": rule_don001,
     "DON002": rule_don002,
 }

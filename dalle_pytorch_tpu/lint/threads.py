@@ -1,0 +1,612 @@
+"""graftrace static half — lock-discipline analysis over thread-bearing
+modules (the graftspmd of concurrency).
+
+Pure-AST, import-free: the target file is parsed, never executed, so the
+sweep runs on any box in milliseconds (same contract as graftlint).  Four
+analyses, each named after the incident class it exists to catch:
+
+* **T1 guarded-field inference** — a field written under ``with
+  self.<lock>`` in any method is *guarded*: every other write must hold a
+  lock, and reads from multi-thread-reachable methods (public methods,
+  properties, ``threading.Thread`` targets) must hold one too.  The lost
+  counter increment / torn dict update class.
+* **T2 blocking-call-under-lock** — ``jit``/``compile``/``prefill``/
+  ``.result()``/``.join()``/file I/O inside a ``with lock:`` body: every
+  other thread that touches that lock stalls for the full blocking call
+  (latency cliff), and a join on a thread that needs the same lock is a
+  guaranteed deadlock.
+* **T3 lock-order graph** — nested ``with lock:`` acquisitions (plus
+  one level of ``self.method()`` call propagation) build a static
+  acquisition-order graph; a cycle is a potential AB/BA deadlock, and a
+  self-edge on a non-reentrant lock is a guaranteed one.
+* **T4 callback-under-lock** — resolving a Future (``set_result`` /
+  ``set_exception`` / ``add_done_callback``) or invoking a caller-supplied
+  callable while holding a lock: the callback can re-enter the very
+  structure whose lock is held.  The classic re-entrancy deadlock (and
+  the bug class behind the router's resolve-outside-the-lock comment).
+
+Pragma grammar (suppressions carry their justification inline, like
+``graftlint: disable``):
+
+* ``# graftrace: unguarded (reason)`` — suppress T1 on that line.
+* ``# graftrace: allow=T2 (reason)`` — suppress the named analyses
+  (comma-separated) on that line.
+
+A pragma without a parenthesized reason is itself a finding (``TP``),
+mirroring PRAGMA001: silent baselines are exactly what this tool exists
+to prevent.  Fixtures proving each analysis has teeth live in
+``threads_fixtures.py``; ``tools/thread_check.py`` is the CLI.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["Finding", "analyze_source", "analyze_file", "ANALYSES"]
+
+ANALYSES = ("T1", "T2", "T3", "T4")
+
+# Constructors whose result is a lock-like object (both the raw threading
+# primitives and the graftrace wrappers; Condition rides the same
+# with-statement discipline).
+_LOCK_CTORS = {
+    "Lock", "RLock", "Condition",
+    "TracedLock", "TracedRLock", "TracedCondition",
+}
+_REENTRANT_CTORS = {"RLock", "TracedRLock", "Condition", "TracedCondition"}
+
+# T2: calls that block (or can block unboundedly) — holding a lock across
+# them stalls every peer of that lock.
+_BLOCKING_BARE = {"open", "sleep", "jit", "compile", "prefill", "urlopen",
+                  "fsync"}
+_BLOCKING_METHOD = {"result", "join"}
+_BLOCKING_DOTTED = {("os", "write"), ("os", "read"), ("os", "fsync"),
+                    ("os", "open"), ("time", "sleep")}
+
+# T4: names that denote caller-supplied callables when invoked as
+# ``self.<name>(...)`` / ``obj.<name>(...)``.
+_CALLBACK_RE = re.compile(r"(^on_|_cb$|_callback$|^callback$|_hook$|hooks?$)")
+_FUTURE_RESOLVE = {"set_result", "set_exception", "add_done_callback"}
+
+# Containers mutated in place: ``self.F.append(x)`` is a write to F.
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "discard",
+    "pop", "popleft", "popitem", "add", "clear", "update", "setdefault",
+}
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftrace:\s*(unguarded|allow=(?P<codes>[A-Z0-9,\s]+))"
+    r"(?P<reason>\s*\(.+\))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str       # T1..T4 | TP
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# pragma handling
+# ---------------------------------------------------------------------------
+
+
+def _parse_pragmas(source: str, path: str):
+    """line -> set of suppressed codes; bare pragmas become TP findings."""
+    suppress: Dict[int, Set[str]] = {}
+    bare: List[Finding] = []
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA_RE.search(text)
+        if not m:
+            continue
+        if not m.group("reason"):
+            bare.append(Finding(
+                "TP", path, lineno,
+                "bare graftrace pragma — a suppression must carry its "
+                "justification: `# graftrace: unguarded (why)`"))
+            continue
+        if m.group(1).startswith("unguarded"):
+            suppress.setdefault(lineno, set()).add("T1")
+        else:
+            for code in m.group("codes").split(","):
+                code = code.strip()
+                if code:
+                    suppress.setdefault(lineno, set()).add(code)
+    return suppress, bare
+
+
+# ---------------------------------------------------------------------------
+# per-class event extraction
+# ---------------------------------------------------------------------------
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _dotted(func: ast.AST) -> Optional[Tuple[str, str]]:
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return (func.value.id, func.attr)
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _ctor_name(value: ast.AST) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        return _terminal_name(value.func)
+    return None
+
+
+@dataclasses.dataclass
+class _Event:
+    kind: str            # "write" | "read" | "call" | "acquire"
+    name: str            # field name, call repr, or lock id
+    line: int
+    held: Tuple[str, ...]
+    method: str
+    extra: Optional[ast.Call] = None
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    """Walks one method body tracking the held-lock stack and recording
+    field writes/reads, calls, and lock acquisitions."""
+
+    def __init__(self, cls: "_ClassModel", method: str,
+                 params: Set[str]) -> None:
+        self.cls = cls
+        self.method = method
+        self.params = params
+        self.held: List[str] = []
+        # the `_locked` suffix convention (telemetry._rotate_locked):
+        # such helpers are documented as called only with the class lock
+        # already held, so seed the held-stack with every lock attr —
+        # right for all four analyses, since the convention asserts the
+        # locks ARE held for the method's whole body.
+        if method.endswith("_locked"):
+            self.held = [f"{cls.name}.{attr}" for attr in cls.lock_attrs]
+        self.events: List[_Event] = []
+        self._write_targets: Set[int] = set()  # id()s of store-ctx nodes
+
+    # --- lock identification ---
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.cls.lock_attrs:
+            return f"{self.cls.name}.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.cls.module_locks:
+            return f"<module>.{expr.id}"
+        return None
+
+    # --- with: acquisition regions ---
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[str] = []
+        for item in node.items:
+            lock = self._lock_id(item.context_expr)
+            if lock is not None:
+                self.events.append(_Event(
+                    "acquire", lock, node.lineno, tuple(self.held),
+                    self.method))
+                self.held.append(lock)
+                acquired.append(lock)
+            else:
+                # the context expr itself may contain reads/calls
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    # --- writes ---
+
+    def _record_write(self, target: ast.AST, line: int) -> None:
+        # unwrap subscript chains: self.F[k] = v / del self.F[k]
+        while isinstance(target, ast.Subscript):
+            target = target.value
+        attr = _self_attr(target)
+        if attr is not None:
+            self._write_targets.add(id(target))
+            self.events.append(_Event(
+                "write", attr, line, tuple(self.held), self.method))
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_write(elt, line)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_write(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._record_write(t, node.lineno)
+        self.generic_visit(node)
+
+    # --- reads + calls ---
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if (attr is not None and isinstance(node.ctx, ast.Load)
+                and id(node) not in self._write_targets):
+            self.events.append(_Event(
+                "read", attr, node.lineno, tuple(self.held), self.method))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.events.append(_Event(
+            "call", _terminal_name(node.func) or "<expr>", node.lineno,
+            tuple(self.held), self.method, extra=node))
+        # in-place mutation counts as a write to the receiver field
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            while isinstance(recv, ast.Subscript):
+                recv = recv.value
+            attr = _self_attr(recv)
+            if attr is not None and node.func.attr in _MUTATORS:
+                self.events.append(_Event(
+                    "write", attr, node.lineno, tuple(self.held),
+                    self.method))
+        dotted = _dotted(node.func)
+        if dotted and dotted[0] == "heapq" and node.args:
+            recv = node.args[0]
+            while isinstance(recv, ast.Subscript):
+                recv = recv.value
+            attr = _self_attr(recv)
+            if attr is not None:
+                self.events.append(_Event(
+                    "write", attr, node.lineno, tuple(self.held),
+                    self.method))
+        self.generic_visit(node)
+
+    # nested defs get their own thread of control only when used as Thread
+    # targets (handled at class level); don't fold their bodies into the
+    # enclosing method's held-stack
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+
+@dataclasses.dataclass
+class _ClassModel:
+    name: str
+    lock_attrs: Dict[str, str]        # attr -> ctor name
+    module_locks: Dict[str, str]      # module-level name -> ctor name
+    methods: Dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict)
+    properties: Set[str] = dataclasses.field(default_factory=set)
+    thread_targets: Set[str] = dataclasses.field(default_factory=set)
+    events: List[_Event] = dataclasses.field(default_factory=list)
+
+
+def _scan_lock_attrs(cls_node: ast.ClassDef) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.Assign):
+            ctor = _ctor_name(node.value)
+            if ctor in _LOCK_CTORS:
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        out[attr] = ctor
+    return out
+
+
+def _scan_module_locks(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            ctor = _ctor_name(node.value)
+            if ctor in _LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = ctor
+    return out
+
+
+def _is_property(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        name = _terminal_name(dec) or (dec.id if isinstance(dec, ast.Name)
+                                       else None)
+        if name in ("property", "cached_property", "setter"):
+            return True
+    return False
+
+
+def _scan_thread_targets(cls_node: ast.ClassDef) -> Set[str]:
+    """Method names passed as ``target=self.X`` to a Thread ctor."""
+    out: Set[str] = set()
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.Call):
+            name = _terminal_name(node.func)
+            if name != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    attr = _self_attr(kw.value)
+                    if attr is not None:
+                        out.add(attr)
+    return out
+
+
+def _build_class_model(cls_node: ast.ClassDef,
+                       module_locks: Dict[str, str]) -> _ClassModel:
+    model = _ClassModel(cls_node.name, _scan_lock_attrs(cls_node),
+                        module_locks)
+    model.thread_targets = _scan_thread_targets(cls_node)
+    for item in cls_node.body:
+        if isinstance(item, ast.FunctionDef):
+            model.methods[item.name] = item
+            if _is_property(item):
+                model.properties.add(item.name)
+            params = {a.arg for a in item.args.args if a.arg != "self"}
+            params |= {a.arg for a in item.args.kwonlyargs}
+            v = _MethodVisitor(model, item.name, params)
+            for stmt in item.body:
+                v.visit(stmt)
+            model.events.extend(v.events)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# the four analyses
+# ---------------------------------------------------------------------------
+
+
+def _t1_guarded_fields(model: _ClassModel, path: str) -> Iterable[Finding]:
+    writes = [e for e in model.events if e.kind == "write"
+              and e.name not in model.lock_attrs]
+    guarded: Dict[str, str] = {}  # field -> one lock it is written under
+    for e in writes:
+        if e.held and e.method != "__init__" and e.name not in guarded:
+            guarded[e.name] = e.held[-1]
+    if not guarded:
+        return
+    reachable = {m for m in model.methods
+                 if not m.startswith("_")} | model.properties \
+        | model.thread_targets
+    for e in model.events:
+        if e.name not in guarded or e.held or e.method == "__init__":
+            continue
+        lock = guarded[e.name]
+        if e.kind == "write":
+            yield Finding(
+                "T1", path, e.line,
+                f"{model.name}.{e.name} is written under {lock} elsewhere "
+                f"but written without a lock in {e.method}() — torn update "
+                f"(annotate `# graftrace: unguarded (reason)` if benign)")
+        elif e.kind == "read" and e.method in reachable:
+            yield Finding(
+                "T1", path, e.line,
+                f"{model.name}.{e.name} is guarded by {lock} but read "
+                f"without it in multi-thread-reachable {e.method}() — "
+                f"stale/torn read (annotate `# graftrace: unguarded "
+                f"(reason)` if benign)")
+
+
+def _is_blocking_call(call: ast.Call) -> Optional[str]:
+    func = call.func
+    dotted = _dotted(func)
+    if dotted in _BLOCKING_DOTTED:
+        return ".".join(dotted)
+    name = _terminal_name(func)
+    if name is None:
+        return None
+    if isinstance(func, ast.Name) and name in _BLOCKING_BARE:
+        return name
+    if isinstance(func, ast.Attribute):
+        if name in _BLOCKING_BARE:
+            return name
+        if name in _BLOCKING_METHOD:
+            # ``", ".join(parts)`` is not a thread join: skip constant-str
+            # receivers and iterable-arg joins; flag no-arg / timeout forms
+            if name == "join":
+                if isinstance(func.value, ast.Constant):
+                    return None
+                if call.args and not isinstance(
+                        call.args[0], ast.Constant):
+                    return None
+            return name
+    return None
+
+
+def _t2_blocking_under_lock(model: _ClassModel,
+                            path: str) -> Iterable[Finding]:
+    for e in model.events:
+        if e.kind != "call" or not e.held or e.extra is None:
+            continue
+        blocked = _is_blocking_call(e.extra)
+        if blocked is not None:
+            yield Finding(
+                "T2", path, e.line,
+                f"blocking call {blocked}() while holding {e.held[-1]} in "
+                f"{model.name}.{e.method}() — every thread needing that "
+                f"lock stalls for the full call (deadlock if the callee "
+                f"needs it too)")
+
+
+def _t3_lock_order(models: List[_ClassModel],
+                   path: str) -> Iterable[Finding]:
+    # edges from direct nesting
+    edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+    ctor_of: Dict[str, str] = {}
+    for model in models:
+        for attr, ctor in model.lock_attrs.items():
+            ctor_of[f"{model.name}.{attr}"] = ctor
+        for name, ctor in model.module_locks.items():
+            ctor_of[f"<module>.{name}"] = ctor
+        # locks a method acquires while holding nothing (for propagation)
+        top_acquires: Dict[str, Set[str]] = {}
+        for e in model.events:
+            if e.kind == "acquire" and not e.held:
+                top_acquires.setdefault(e.method, set()).add(e.name)
+        for e in model.events:
+            if e.kind == "acquire":
+                for held in e.held:
+                    edges.setdefault(
+                        (held, e.name),
+                        (e.line, f"{model.name}.{e.method}"))
+            elif (e.kind == "call" and e.held and e.extra is not None):
+                # one level of self-call propagation
+                attr = _self_attr(e.extra.func)
+                if attr in top_acquires:
+                    for inner in top_acquires[attr]:
+                        for held in e.held:
+                            edges.setdefault(
+                                (held, inner),
+                                (e.line, f"{model.name}.{e.method}"))
+    # self-edge on a non-reentrant lock: guaranteed deadlock
+    for (a, b), (line, where) in sorted(edges.items(),
+                                        key=lambda kv: kv[1][0]):
+        if a == b and ctor_of.get(a) not in _REENTRANT_CTORS:
+            yield Finding(
+                "T3", path, line,
+                f"re-entrant acquisition of non-reentrant lock {a} in "
+                f"{where} — guaranteed self-deadlock")
+    # cycle over distinct locks: potential AB/BA deadlock
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        if a != b:
+            adj.setdefault(a, []).append(b)
+    cycle = _find_cycle(adj)
+    if cycle is not None:
+        first = edges.get((cycle[0], cycle[1])) or (0, "?")
+        yield Finding(
+            "T3", path, first[0],
+            "lock acquisition order cycle "
+            + " -> ".join(cycle + [cycle[0]])
+            + " — two threads entering from opposite ends deadlock")
+
+
+def _find_cycle(adj: Dict[str, List[str]]) -> Optional[List[str]]:
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    parent: Dict[str, str] = {}
+    for start in sorted(adj):
+        if color.get(start, WHITE) != WHITE:
+            continue
+        stack = [(start, iter(sorted(adj.get(start, ()))))]
+        color[start] = GREY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                c = color.get(nxt, WHITE)
+                if c == GREY:
+                    cycle = [node]
+                    cur = node
+                    while cur != nxt:
+                        cur = parent[cur]
+                        cycle.append(cur)
+                    cycle.reverse()
+                    return cycle
+                if c == WHITE:
+                    color[nxt] = GREY
+                    parent[nxt] = node
+                    stack.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                stack.pop()
+    return None
+
+
+def _t4_callback_under_lock(model: _ClassModel,
+                            path: str) -> Iterable[Finding]:
+    param_names: Dict[str, Set[str]] = {}
+    for name, fn in model.methods.items():
+        params = {a.arg for a in fn.args.args if a.arg != "self"}
+        params |= {a.arg for a in fn.args.kwonlyargs}
+        param_names[name] = params
+    for e in model.events:
+        if e.kind != "call" or not e.held or e.extra is None:
+            continue
+        func = e.extra.func
+        name = _terminal_name(func)
+        if name in _FUTURE_RESOLVE:
+            yield Finding(
+                "T4", path, e.line,
+                f"{name}() while holding {e.held[-1]} in "
+                f"{model.name}.{e.method}() — done-callbacks run inline "
+                f"and can re-enter the locked structure (resolve futures "
+                f"OUTSIDE the lock)")
+        elif (isinstance(func, ast.Name)
+              and func.id in param_names.get(e.method, ())):
+            yield Finding(
+                "T4", path, e.line,
+                f"caller-supplied callable {func.id}() invoked while "
+                f"holding {e.held[-1]} in {model.name}.{e.method}() — "
+                f"re-entrancy deadlock if the callback touches this "
+                f"structure")
+        elif name is not None and _CALLBACK_RE.search(name):
+            yield Finding(
+                "T4", path, e.line,
+                f"callback-like {name}() invoked while holding "
+                f"{e.held[-1]} in {model.name}.{e.method}() — re-entrancy "
+                f"hazard (invoke after release, or annotate "
+                f"`# graftrace: allow=T4 (reason)`)")
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def analyze_source(source: str, path: str = "<source>",
+                   select: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run T1–T4 over one module's source; returns surviving findings
+    (pragma-suppressed ones dropped, bare pragmas reported as TP)."""
+    tree = ast.parse(source, filename=path)
+    suppress, findings = _parse_pragmas(source, path)
+    module_locks = _scan_module_locks(tree)
+    models = [_build_class_model(node, module_locks)
+              for node in tree.body if isinstance(node, ast.ClassDef)]
+    raw: List[Finding] = []
+    for model in models:
+        raw.extend(_t1_guarded_fields(model, path))
+        raw.extend(_t2_blocking_under_lock(model, path))
+        raw.extend(_t4_callback_under_lock(model, path))
+    raw.extend(_t3_lock_order(models, path))
+    wanted = set(select) if select is not None else set(ANALYSES)
+    for f in raw:
+        if f.code not in wanted:
+            continue
+        if f.code in suppress.get(f.line, ()):
+            continue
+        findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.code))
+    return findings
+
+
+def analyze_file(path, select: Optional[Iterable[str]] = None
+                 ) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    return analyze_source(source, str(path), select=select)

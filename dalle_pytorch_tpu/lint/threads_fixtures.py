@@ -1,0 +1,180 @@
+"""Deliberately-broken concurrent classes — the teeth-proof for graftrace.
+
+One fixture class per analysis, each reproducing the bug class its
+analysis exists to catch (mirrors ``spmd_fixtures.py``): an unguarded
+counter write racing a locked writer (T1), a compile inside a ``with
+lock:`` body (T2), an AB/BA acquisition inversion (T3), and a Future
+resolved while holding the lock (T4) — plus a clean twin for each that
+must pass.  Used by tests/test_thread_check.py and ``tools/
+thread_check.py --selftest``; never imported by production code, and the
+classes are never instantiated by the checker (pure AST analysis).
+"""
+from __future__ import annotations
+
+import threading
+
+
+def _compile_fn(fn):  # stands in for jax.jit et al. in the T2 fixtures
+    return fn
+
+
+# --- T1: unguarded write to a lock-guarded field ---------------------------
+
+
+class BrokenUnguardedCounter:
+    """``served`` is written under the lock in ``retire`` but bumped
+    lock-free in ``record_error`` — two driver threads lose increments.
+    Must be CAUGHT by T1."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.served = 0
+
+    def retire(self):
+        with self._lock:
+            self.served += 1
+
+    def record_error(self):
+        self.served += 1  # racing write, no lock
+
+    def snapshot(self):
+        return self.served  # racing read from a public method
+
+
+class CleanGuardedCounter:
+    """The clean twin: every touch of ``served`` holds the lock.
+    Must PASS T1."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.served = 0
+
+    def retire(self):
+        with self._lock:
+            self.served += 1
+
+    def record_error(self):
+        with self._lock:
+            self.served += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.served
+
+
+# --- T2: blocking call while holding a lock --------------------------------
+
+
+class BrokenCompileUnderLock:
+    """Compiles (seconds) inside the admission lock — every submitter
+    stalls behind the trace.  Must be CAUGHT by T2."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._step = None
+
+    def admit(self, fn):
+        with self._lock:
+            self._step = _compile_fn(fn)  # pretend this is jax.jit
+
+    def admit_traced(self, fn):
+        with self._lock:
+            self._step = compile(fn, "<fixture>", "eval")
+
+
+class CleanCompileOutsideLock:
+    """The clean twin: compile first, publish the result under the lock.
+    Must PASS T2."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._step = None
+
+    def admit(self, fn):
+        step = compile(fn, "<fixture>", "eval")
+        with self._lock:
+            self._step = step
+
+
+# --- T3: AB/BA lock-order inversion ----------------------------------------
+
+
+class BrokenOrderInversion:
+    """``transfer`` takes A then B, ``refund`` takes B then A — two
+    threads entering from opposite ends deadlock.  Must be CAUGHT by
+    T3."""
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.balance = 0
+
+    def transfer(self):
+        with self._lock_a:
+            with self._lock_b:
+                self.balance += 1
+
+    def refund(self):
+        with self._lock_b:
+            with self._lock_a:
+                self.balance -= 1
+
+
+class CleanOrderedPair:
+    """The clean twin: both paths acquire A before B.  Must PASS T3."""
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.balance = 0
+
+    def transfer(self):
+        with self._lock_a:
+            with self._lock_b:
+                self.balance += 1
+
+    def refund(self):
+        with self._lock_a:
+            with self._lock_b:
+                self.balance -= 1
+
+
+# --- T4: resolving a Future / firing a callback under the lock -------------
+
+
+class BrokenResolveUnderLock:
+    """Resolves the request future while still holding the table lock —
+    a done-callback that re-submits re-enters ``resolve`` and deadlocks.
+    Must be CAUGHT by T4."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._futures = {}
+
+    def resolve(self, rid, value):
+        with self._lock:
+            fut = self._futures.pop(rid)
+            fut.set_result(value)  # inline done-callbacks under the lock
+
+    def notify(self, on_done):
+        with self._lock:
+            on_done(len(self._futures))  # caller-supplied callable
+
+
+class CleanResolveOutsideLock:
+    """The clean twin: pop under the lock, resolve after release (the
+    router's resolve-outside-the-lock discipline).  Must PASS T4."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._futures = {}
+
+    def resolve(self, rid, value):
+        with self._lock:
+            fut = self._futures.pop(rid)
+        fut.set_result(value)
+
+    def notify(self, on_done):
+        with self._lock:
+            n = len(self._futures)
+        on_done(n)

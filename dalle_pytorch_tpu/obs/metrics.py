@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils import locks
+
 # default histogram buckets: serve latencies span ~ms..minute
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
@@ -90,7 +92,7 @@ class Histogram:
         self.counts = [0] * len(self.buckets)
         self.sum = 0.0
         self.count = 0
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("metrics.histogram")
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -112,7 +114,7 @@ class MetricsRegistry:
     the registry lock, subsequent lookups hit a dict."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("metrics.registry")
         # name -> (kind, help, {label_key -> instrument})
         self._families: Dict[str, Tuple[str, str, Dict[_LabelKey, object]]] \
             = {}
@@ -121,7 +123,10 @@ class MetricsRegistry:
     def _get(self, kind: str, name: str, help_: str, labels: Dict[str, str],
              factory: Callable[[], object]):
         key = _label_key(labels)
-        fam = self._families.get(name)
+        # lock-free fast path: after first creation every hot-path call is
+        # two dict gets (CPython dict reads are atomic; a racing creation
+        # falls through to the locked slow path and setdefault wins once)
+        fam = self._families.get(name)  # graftrace: unguarded (hot-path read; a miss or torn view only falls through to the locked setdefault below)
         if fam is not None:
             inst = fam[2].get(key)
             if inst is not None:
@@ -131,7 +136,7 @@ class MetricsRegistry:
             if fam[0] != kind:
                 raise ValueError(
                     f"metric {name!r} already registered as {fam[0]}")
-            return fam[2].setdefault(key, factory())
+            return fam[2].setdefault(key, factory())  # graftrace: allow=T4 (factory is one of our instrument constructors — Counter/Gauge/Histogram — never caller code, so it cannot re-enter the registry)
 
     def counter(self, name: str, help: str = "", **labels) -> Counter:
         return self._get("counter", name, help, labels, Counter)
@@ -147,13 +152,23 @@ class MetricsRegistry:
 
     @property
     def series_count(self) -> int:
-        return sum(len(fam[2]) for fam in self._families.values())
+        with self._lock:
+            return sum(len(fam[2]) for fam in self._families.values())
 
     def render(self) -> str:
-        """Prometheus text exposition format v0.0.4."""
+        """Prometheus text exposition format v0.0.4.  The family/series
+        tables are snapshotted under the registry lock — the /metrics
+        scrape thread renders while hot paths register new series, and
+        iterating the live dicts would die with "dict changed size during
+        iteration".  Instrument values are read lock-free (atomic
+        attribute reads; a scrape sees each counter at some recent
+        point)."""
+        with self._lock:
+            families = {name: (fam[0], fam[1], dict(fam[2]))
+                        for name, fam in self._families.items()}
         lines: List[str] = []
-        for name in sorted(self._families):
-            kind, help_, series = self._families[name]
+        for name in sorted(families):
+            kind, help_, series = families[name]
             if help_:
                 lines.append(f"# HELP {name} {help_}")
             lines.append(f"# TYPE {name} {kind}")

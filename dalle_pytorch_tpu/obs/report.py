@@ -275,6 +275,37 @@ def build_report(events: List[dict]) -> dict:
         "loader_stalls": sum(r.get("name") == "loader_stall" for r in data),
     }
 
+    # --- locks (graftrace witness) ------------------------------------------
+    # one kind="lock" event per lock name (locks.emit_telemetry), plus one
+    # "order_graph" verdict event; last record per (host, name) wins — the
+    # stats are cumulative counters, not deltas
+    lock_events = [r for r in events if r.get("kind") == "lock"]
+    per_lock: Dict[tuple, dict] = {}
+    graph = None
+    for r in lock_events:
+        if r.get("name") == "order_graph":
+            graph = r
+        else:
+            per_lock[(r.get("host", 0), r.get("name", "?"))] = r
+    lock_rows = sorted(
+        ({"name": name, "host": host,
+          "acquires": int(r.get("acquires", 0)),
+          "contended": int(r.get("contended", 0)),
+          "wait_s": float(r.get("wait_s", 0.0)),
+          "held_s": float(r.get("held_s", 0.0)),
+          "held_max_s": float(r.get("held_max_s", 0.0))}
+         for (host, name), r in per_lock.items()),
+        key=lambda row: -row["held_s"])
+    lock_report = {
+        "locks": lock_rows[:20],
+        "contended_total": sum(row["contended"] for row in lock_rows),
+        "order_graph": (None if graph is None else {
+            "edges": graph.get("edges"),
+            "acyclic": graph.get("acyclic"),
+            "cycle": graph.get("cycle"),
+        }),
+    }
+
     return {
         "records": len(events),
         "by_kind": by_kind,
@@ -287,6 +318,7 @@ def build_report(events: List[dict]) -> dict:
         "mem": mem_report,
         "faults": faults,
         "data": data_report,
+        "locks": lock_report,
         "torn_spans": [{"kind": r.get("kind"), "name": r.get("name"),
                         "host": r.get("host", 0), "seq": r.get("seq")}
                        for r in _torn_spans(events)][:20],
@@ -507,6 +539,21 @@ def render_text(report: dict) -> str:
                      f"{d['sample_quarantines']}, shard quarantines "
                      f"{d['shard_quarantines']}, loader stalls "
                      f"{d['loader_stalls']}")
+    lk = report.get("locks") or {}
+    if lk.get("locks"):
+        lines.append("-- locks (graftrace witness) --")
+        for row in lk["locks"][:8]:  # already sorted by held time, desc
+            lines.append(
+                f"  {row['name']} (host {row['host']}): "
+                f"{row['acquires']} acquires, {row['contended']} contended "
+                f"(wait {_fmt(row['wait_s'])}s), held {_fmt(row['held_s'])}s "
+                f"total / {_fmt(row['held_max_s'])}s max")
+        graph = lk.get("order_graph")
+        if graph is not None:
+            lines.append(
+                f"  order graph: {graph.get('edges')} edge(s), "
+                + ("acyclic" if graph.get("acyclic")
+                   else f"CYCLE: {graph.get('cycle')}"))
     if report["torn_spans"]:
         lines.append("-- torn spans (death inside) --")
         for t in report["torn_spans"][:10]:

@@ -53,6 +53,8 @@ import time
 from pathlib import Path
 from typing import Iterable, List, Optional
 
+from ..utils import locks
+
 SCHEMA_VERSION = 1
 
 # envelope keys every record carries; payload fields must not collide
@@ -201,7 +203,7 @@ class Telemetry:
         self._metrics = None
         self._alerts = None
         self._in_hook = False
-        self._lock = threading.RLock()
+        self._lock = locks.TracedRLock("telemetry")
         self._seq = 0
         self._fd: Optional[int] = None
         self._bytes = 0
@@ -233,14 +235,14 @@ class Telemetry:
 
     @property
     def enabled(self) -> bool:
-        return self._fd is not None
+        return self._fd is not None  # graftrace: unguarded (free-when-off contract: one atomic attribute read; _fd only transitions via close/rotate and a stale view is indistinguishable from a racing close)
 
     @property
     def seq(self) -> int:
         """Sequence number of the last emitted record (0 before any) —
         what heartbeats ride so monitors can line a stalled host up with
         its telemetry tail."""
-        return self._seq
+        return self._seq  # graftrace: unguarded (monotonic watermark: an int read is atomic and heartbeats only need "some recent seq", never an exact one)
 
     # --- emission ---------------------------------------------------------
 
@@ -248,7 +250,7 @@ class Telemetry:
         """Append one record; returns its ``seq`` (None when disabled).
         Payload ``fields`` must be JSON-serializable (anything else is
         stringified) and must not collide with :data:`ENVELOPE_KEYS`."""
-        if self._fd is None:
+        if self._fd is None:  # graftrace: unguarded (the documented free-when-off fast path: one attribute check, no lock; a record racing close() is dropped, which close() already implies)
             return None
         with self._lock:
             self._seq += 1
@@ -262,7 +264,7 @@ class Telemetry:
             line = (json.dumps(rec, separators=(",", ":"), default=str)
                     + "\n").encode()
             try:
-                os.write(self._fd, line)
+                os.write(self._fd, line)  # graftrace: allow=T2 (deliberate: the lock IS the serializer for the O_APPEND stream — one writer at a time keeps records whole; writes are line-sized and local)
             except OSError:
                 # a full/broken disk must never take the run down with it:
                 # telemetry is diagnostics, losing it is the lesser failure
@@ -293,7 +295,7 @@ class Telemetry:
 
     def span(self, kind: str, name: str, **fields):
         """Context manager for a timed span (B/E record pair)."""
-        if self._fd is None:
+        if self._fd is None:  # graftrace: unguarded (free-when-off fast path, same contract as event())
             return _NULL_SPAN
         return _Span(self, kind, name, fields)
 
@@ -340,7 +342,7 @@ class Telemetry:
         filesystem all hosts mount) and emit a ref-bearing beacon.  The
         env ``GRAFT_CLOCK_RDV`` arms the same thing on the periodic
         beacon cadence."""
-        if self._fd is None:
+        if self._fd is None:  # graftrace: unguarded (free-when-off fast path, same contract as event())
             return None
         with self._lock:
             prev = self._rdv_dir
@@ -393,7 +395,7 @@ class Telemetry:
                 p.unlink()
             except OSError:
                 pass
-        self._fd = os.open(self.path,
+        self._fd = os.open(self.path,  # graftrace: allow=T2 (rotation happens at most once per rotate_bytes of output; reopening under the lock is what keeps racing writers off the renamed file)
                            os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
         self._bytes = 0
 
@@ -407,7 +409,7 @@ class Telemetry:
 # --- module-level singleton: how library layers participate ---------------
 
 _active: Optional[Telemetry] = None
-_active_lock = threading.Lock()
+_active_lock = locks.TracedLock("telemetry.active")
 
 
 def init(directory, run_id: Optional[str] = None, **kwargs) -> Telemetry:

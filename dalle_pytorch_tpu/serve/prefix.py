@@ -38,6 +38,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
+from ..utils import locks
+
 Key = Tuple[int, ...]
 
 
@@ -76,9 +78,10 @@ def _common_prefix_len(a: Key, b: Key) -> int:
 
 class RadixPrefixCache:
     """Refcounted radix tree of prompt-token tuples -> batch-1 prefill
-    payloads.  Single-threaded by design: the scheduler calls it only
-    under its own lock, mirroring every other host-side structure in
-    serve/.
+    payloads.  Thread-safe: every public method takes the internal
+    ``prefix`` lock, so multiple replica drivers (or the router's retry
+    path racing a driver) can acquire/insert/release concurrently without
+    corrupting refcounts or the tree.
 
     ``capacity`` bounds the number of RESIDENT payloads; eviction is LRU
     over refcount-zero entries only, so the bound is exceeded while more
@@ -91,6 +94,7 @@ class RadixPrefixCache:
         assert capacity >= 1, "a zero-capacity prefix cache is just 'off'"
         self.capacity = capacity
         self.prefill_flops = float(prefill_flops)
+        self._lock = locks.TracedLock("prefix")
         self._root = _Node()
         self._entries: Dict[Key, _Entry] = {}  # iteration/LRU index
         self._stamp = 0
@@ -173,17 +177,18 @@ class RadixPrefixCache:
         once), or None on miss.  Hit/miss and FLOPs-saved counters
         update here."""
         key = tuple(int(t) for t in tokens)
-        node = self._find(key)
-        if node is None or node.entry is None:
-            self.misses += 1
-            return None
-        entry = node.entry
-        entry.refcount += 1
-        self._stamp += 1
-        entry.last_used = self._stamp
-        self.hits += 1
-        self.flops_saved += entry.flops
-        return entry.payload
+        with self._lock:
+            node = self._find(key)
+            if node is None or node.entry is None:
+                self.misses += 1
+                return None
+            entry = node.entry
+            entry.refcount += 1
+            self._stamp += 1
+            entry.last_used = self._stamp
+            self.hits += 1
+            self.flops_saved += entry.flops
+            return entry.payload
 
     def insert(self, tokens, payload) -> object:
         """Store a freshly-computed prefill payload and pin it for the
@@ -193,32 +198,34 @@ class RadixPrefixCache:
         payload and pins that instead (two racing misses on one prompt
         must not hold divergent device copies)."""
         key = tuple(int(t) for t in tokens)
-        existing = self._entries.get(key)
-        if existing is not None:
-            existing.refcount += 1
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                existing.refcount += 1
+                self._stamp += 1
+                existing.last_used = self._stamp
+                return existing.payload
             self._stamp += 1
-            existing.last_used = self._stamp
-            return existing.payload
-        self._stamp += 1
-        entry = _Entry(key, payload, self.prefill_flops, self._stamp)
-        entry.refcount = 1
-        self._insert_node(key).entry = entry
-        self._entries[key] = entry
-        self._evict_to_capacity()
-        return entry.payload
+            entry = _Entry(key, payload, self.prefill_flops, self._stamp)
+            entry.refcount = 1
+            self._insert_node(key).entry = entry
+            self._entries[key] = entry
+            self._evict_to_capacity_locked()
+            return entry.payload
 
     def release(self, tokens) -> None:
         """Unpin one reference (retire/fail/preempt/stop all funnel
         here).  The payload stays resident for future hits until LRU
         eviction claims it."""
         key = tuple(int(t) for t in tokens)
-        entry = self._entries.get(key)
-        assert entry is not None, "release of an untracked prefix"
-        assert entry.refcount > 0, "refcount underflow — double release"
-        entry.refcount -= 1
-        self._evict_to_capacity()
+        with self._lock:
+            entry = self._entries.get(key)
+            assert entry is not None, "release of an untracked prefix"
+            assert entry.refcount > 0, "refcount underflow — double release"
+            entry.refcount -= 1
+            self._evict_to_capacity_locked()
 
-    def _evict_to_capacity(self) -> None:
+    def _evict_to_capacity_locked(self) -> None:
         while len(self._entries) > self.capacity:
             victims = [e for e in self._entries.values() if e.refcount == 0]
             if not victims:
@@ -229,15 +236,16 @@ class RadixPrefixCache:
             self.evictions += 1
 
     def stats(self) -> dict:
-        looked = self.hits + self.misses
-        return {
-            "entries": len(self._entries),
-            "pinned": sum(1 for e in self._entries.values()
-                          if e.refcount > 0),
-            "capacity": self.capacity,
-            "hits": self.hits,
-            "misses": self.misses,
-            "hit_rate": (self.hits / looked) if looked else 0.0,
-            "evictions": self.evictions,
-            "prefill_flops_saved": self.flops_saved,
-        }
+        with self._lock:
+            looked = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "pinned": sum(1 for e in self._entries.values()
+                              if e.refcount > 0),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / looked) if looked else 0.0,
+                "evictions": self.evictions,
+                "prefill_flops_saved": self.flops_saved,
+            }

@@ -56,6 +56,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import telemetry
 from ..obs.telemetry import Telemetry
 from ..utils import faults
+from ..utils import locks
 from .scheduler import GenerationServer, ServerStopped
 
 JOINING = "joining"
@@ -91,7 +92,7 @@ class Replica:
         self.warmup_text = warmup_text
         self.idle_sleep_s = float(idle_sleep_s)
         self._state = JOINING
-        self._state_lock = threading.Lock()
+        self._state_lock = locks.TracedLock("replica.state")
         self.last_beat = self._time()
         self.ticks = 0        # driver loop passes (the heartbeat cadence)
         self.work_ticks = 0   # decode ticks that advanced a slot

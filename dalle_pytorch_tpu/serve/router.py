@@ -73,6 +73,7 @@ import numpy as np
 from ..obs import metrics as obs_metrics
 from ..obs import telemetry
 from ..utils import faults
+from ..utils import locks
 from .replica import DEAD, DRAINING, SERVING, Replica, ReplicaDown
 from .scheduler import LATENCY, SLO_CLASSES, THROUGHPUT, ServerStopped
 
@@ -179,7 +180,7 @@ class FleetRouter:
         self.drain_grace_s = float(drain_grace_s)
         self.monitor_interval_s = float(monitor_interval_s)
 
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("router")
         self._replicas: Dict[str, Replica] = {}
         self._tracked: Dict[int, _Tracked] = {}
         self._retries: List[Tuple[float, int]] = []   # heap of (due, rid)
@@ -247,14 +248,20 @@ class FleetRouter:
         return self
 
     def wait_serving(self, n: int = 1, timeout_s: float = 30.0) -> None:
-        """Block until ``n`` replicas are SERVING (warm) or raise."""
+        """Block until ``n`` replicas are SERVING (warm) or raise.  Waits
+        on the router's stop event rather than a bare sleep, so a close()
+        racing the warm-up unblocks the caller immediately (THR002: poll
+        loops wait on an Event, never sleep against shared state)."""
         deadline = self._time() + timeout_s
         while len(self._serving()) < n:
+            if self._closing:
+                raise RouterError("router closed while waiting for "
+                                  "replicas to warm")
             if self._time() > deadline:
                 raise RuntimeError(
                     f"{len(self._serving())}/{n} replicas serving after "
                     f"{timeout_s}s")
-            time.sleep(0.005)
+            self._stop_evt.wait(0.005)
 
     def close(self) -> None:
         """Stop monitoring, halt every live replica, and fail any still
@@ -315,7 +322,8 @@ class FleetRouter:
             err = ShedError(
                 f"shed: {slo} fleet backlog {depth} >= bound {bound}",
                 slo=slo, depth=depth, bound=bound)
-            self.shed[slo] += 1
+            with self._lock:
+                self.shed[slo] += 1
             self._emit("router", "shed", rid=rid, slo=slo, depth=depth,
                        bound=bound)
             if reg is not None:
@@ -444,7 +452,7 @@ class FleetRouter:
         due = self._time() + delay
         with self._lock:
             heapq.heappush(self._retries, (due, rid))
-        self.retries_total += 1
+            self.retries_total += 1
         self._emit("router", "retry", rid=rid, attempt=tracked.attempts,
                    delay_s=round(delay, 4), replica=tracked.replica,
                    error=repr(exc))
@@ -459,7 +467,7 @@ class FleetRouter:
                 return
             tracked.resolved = True
             self._tracked.pop(tracked.handle.request_id, None)
-        self.resolved_ok += 1
+            self.resolved_ok += 1
         self._emit("router", "resolve", rid=tracked.handle.request_id,
                    replica=tracked.replica, attempts=tracked.attempts,
                    latency_s=self._time() - tracked.handle.submitted_at)
@@ -472,7 +480,7 @@ class FleetRouter:
                 return
             tracked.resolved = True
             self._tracked.pop(tracked.handle.request_id, None)
-        self.resolved_err += 1
+            self.resolved_err += 1
         self._emit("router", "fail", rid=tracked.handle.request_id,
                    replica=tracked.replica, attempts=tracked.attempts,
                    error=repr(err))
@@ -525,16 +533,21 @@ class FleetRouter:
                           else f"heartbeat stale {r.beat_age():.2f}s")
                 self._declare_dead(r, reason=reason)
             elif state == DRAINING:
-                deadline = self._drains.get(r.name)
+                with self._lock:
+                    deadline = self._drains.get(r.name)
+                # finish_drain/halt join the driver thread — they must run
+                # OUTSIDE the lock (the done-callbacks they trigger take it)
                 if not r.server.busy:
                     left = r.finish_drain()
-                    self._drains.pop(r.name, None)
+                    with self._lock:
+                        self._drains.pop(r.name, None)
                     self._emit("router", "drain_complete", replica=r.name,
                                in_grace=True, migrated=len(left))
                 elif deadline is not None and now > deadline:
                     unfinished = r.halt(ReplicaDown(
                         f"replica {r.name}: drain grace expired"))
-                    self._drains.pop(r.name, None)
+                    with self._lock:
+                        self._drains.pop(r.name, None)
                     self._emit("router", "drain_expired", replica=r.name,
                                migrated=len(unfinished))
         if now - self._last_probe >= self.probe_every_s:
@@ -547,10 +560,12 @@ class FleetRouter:
                 # sick-but-beating replica can still finish its slots
                 hz = r.healthz()
                 if hz.get("ok"):
-                    self._probe_fail[r.name] = 0
+                    with self._lock:
+                        self._probe_fail[r.name] = 0
                 else:
-                    n = self._probe_fail[r.name] = \
-                        self._probe_fail.get(r.name, 0) + 1
+                    with self._lock:
+                        n = self._probe_fail[r.name] = \
+                            self._probe_fail.get(r.name, 0) + 1
                     self._emit("router", "probe_fail", replica=r.name,
                                consecutive=n)
                     if n >= self.probe_failures:
@@ -567,7 +582,8 @@ class FleetRouter:
                 self._dispatch(tracked)
 
     def _declare_dead(self, replica: Replica, *, reason: str) -> None:
-        self.replica_deaths += 1
+        with self._lock:
+            self.replica_deaths += 1
         telemetry.note(
             "router", "replica_dead",
             f"replica {replica.name} declared dead ({reason}); migrating "
@@ -593,7 +609,8 @@ class FleetRouter:
         with self._lock:
             replica = self._replicas[name]
         grace = self.drain_grace_s if grace_s is None else float(grace_s)
-        self._drains[name] = self._time() + grace
+        with self._lock:
+            self._drains[name] = self._time() + grace
         self._emit("router", "drain_begin", replica=name, grace_s=grace,
                    reason=reason)
         replica.begin_drain(reason=reason)
@@ -609,14 +626,15 @@ class FleetRouter:
         with self._lock:
             outstanding = len(self._tracked)
             submitted = self._next_rid
-        shed_total = sum(self.shed.values())
-        return dict(
-            submitted=submitted, resolved_ok=self.resolved_ok,
-            resolved_err=self.resolved_err, shed=shed_total,
-            shed_by_class=dict(self.shed), outstanding=outstanding,
-            retries=self.retries_total, replica_deaths=self.replica_deaths,
-            balanced=(submitted == self.resolved_ok + self.resolved_err
-                      + shed_total + outstanding))
+            shed_total = sum(self.shed.values())
+            return dict(
+                submitted=submitted, resolved_ok=self.resolved_ok,
+                resolved_err=self.resolved_err, shed=shed_total,
+                shed_by_class=dict(self.shed), outstanding=outstanding,
+                retries=self.retries_total,
+                replica_deaths=self.replica_deaths,
+                balanced=(submitted == self.resolved_ok + self.resolved_err
+                          + shed_total + outstanding))
 
     def stats(self) -> dict:
         """Fleet snapshot: per-replica lifecycle + load, plus the audit

@@ -58,6 +58,7 @@ from ..obs import mem as obs_mem
 from ..obs import metrics as obs_metrics
 from ..obs import telemetry
 from ..utils import faults
+from ..utils import locks
 from .engine import SlotArena
 from .prefix import RadixPrefixCache
 
@@ -199,7 +200,7 @@ class GenerationServer:
         self.slo_targets = dict(slo_targets or {})
         self._time = time_fn
         self._seed = seed
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("scheduler")
         self._queues: Dict[str, Deque[ServeHandle]] = {
             LATENCY: collections.deque(), THROUGHPUT: collections.deque()}
         self._running: Dict[int, _Running] = {}       # slot -> running
@@ -308,7 +309,7 @@ class GenerationServer:
                 raise RuntimeError(
                     f"server not idle after {max_ticks} ticks: "
                     f"{len(self._running)} running, "
-                    f"{sum(map(len, self._queues.values()))} queued")
+                    f"{self.backlog()['queued_total']} queued")
 
     def drive(self, arrivals: Sequence[Tuple[float, dict]],
               max_ticks: Optional[int] = None) -> dict:
@@ -330,7 +331,7 @@ class GenerationServer:
             if not self.busy:
                 # idle gap before the next arrival: jump the open loop
                 # forward instead of busy-waiting on the clock
-                time.sleep(min(0.001, max(0.0, pending[i][0] - now)))
+                time.sleep(min(0.001, max(0.0, pending[i][0] - now)))  # graftlint: disable=THR002 (open-loop trace pacing against the local clock — the wake condition is wall time reaching the next arrival offset, not shared state, and drive() runs on the single driver thread with nothing to stop early for)
                 continue
             self.step()
             ticks += 1
@@ -619,11 +620,13 @@ class GenerationServer:
 
     @property
     def stopped(self) -> bool:
-        return self._stopped
+        with self._lock:
+            return self._stopped
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._lock:
+            return self._draining
 
     def _zero_queue_gauges(self) -> None:
         reg = obs_metrics.active()

@@ -62,6 +62,7 @@ from pathlib import Path
 from typing import Optional
 
 from . import faults
+from . import locks
 from ..obs import telemetry
 from .checkpoint import (is_process_zero, save_checkpoint,
                          save_checkpoint_sharded)
@@ -206,6 +207,10 @@ class CheckpointManager:
         # from an unsynchronized background thread can interleave across
         # hosts, so sharded saves stay synchronous by construction.
         self.async_save = bool(async_save) and not self.sharded
+        # _worker/last_error are the caller-thread <-> ckpt-async-N
+        # handoff: both sides go through _async_lock (the join itself runs
+        # outside it, so a slow write never blocks in_flight probes).
+        self._async_lock = locks.TracedLock("ckpt.async")
         self._worker: Optional["threading.Thread"] = None
         self.last_error: Optional[BaseException] = None
 
@@ -251,7 +256,8 @@ class CheckpointManager:
             worker = threading.Thread(
                 target=self._save_bg, args=(step, payload),
                 name=f"ckpt-async-{step}", daemon=True)
-            self._worker = worker
+            with self._async_lock:
+                self._worker = worker
             worker.start()
             return None
         return self._save_blocking(step, payload)
@@ -261,7 +267,8 @@ class CheckpointManager:
             self._save_blocking(step, payload)
         # graftlint: disable=EXC001 (background writer: the error is recorded in last_error, logged loudly, and the next cadence save proceeds — the log-not-fatal managed-save contract)
         except BaseException as e:  # noqa: BLE001
-            self.last_error = e
+            with self._async_lock:
+                self.last_error = e
             telemetry.note("ckpt", "save_failed",
                            f"async save step {step} failed: {e}",
                            prefix="[ckpt]", step=int(step))
@@ -271,13 +278,16 @@ class CheckpointManager:
         committed checkpoint before proceeding (the trainers' interrupt
         path, process exit) call this; a recorded background failure stays
         in ``last_error`` for inspection."""
-        worker, self._worker = self._worker, None
-        if worker is not None:
-            worker.join()
+        with self._async_lock:
+            worker, self._worker = self._worker, None
+        if worker is not None:  # join OUTSIDE the lock: it can block for
+            worker.join()       # the whole write (T2 otherwise)
 
     @property
     def in_flight(self) -> bool:
-        return self._worker is not None and self._worker.is_alive()
+        with self._async_lock:
+            worker = self._worker
+        return worker is not None and worker.is_alive()
 
     def finish(self) -> None:
         """End-of-run barrier: join the writer and surface (log) any
@@ -285,10 +295,12 @@ class CheckpointManager:
         calls this it is exiting, and the on-disk state is whatever the
         commit protocol made durable."""
         self.wait()
-        if self.last_error is not None:
+        with self._async_lock:
+            err = self.last_error
+        if err is not None:
             telemetry.note("ckpt", "save_failed_earlier",
                            f"note: an async save failed earlier: "
-                           f"{self.last_error}", prefix="[ckpt]")
+                           f"{err}", prefix="[ckpt]")
 
     def _save_blocking(self, step: int, payload: dict) -> Path:
         existing = verify(self._dir_for(step))

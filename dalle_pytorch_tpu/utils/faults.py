@@ -106,6 +106,7 @@ import threading
 from typing import Dict, FrozenSet, List, Optional
 
 from ..obs import telemetry
+from . import locks
 
 _ACTIONS = ("fail_after", "every", "truncate", "at_step", "at_tick",
             "grace_ms")
@@ -136,7 +137,7 @@ class FaultRegistry:
     """Parsed ``GRAFT_FAULTS`` spec + per-site hit counters."""
 
     def __init__(self, spec: str = ""):
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("faults.registry")
         self._triggers: Dict[str, List[_Trigger]] = {}
         self._hits: Dict[str, int] = {}
         for entry in (e.strip() for e in (spec or "").split(",")):
@@ -226,7 +227,7 @@ def _record(site: str, action: str, hits: int, step: Optional[int]) -> None:
 
 
 _registry: Optional[FaultRegistry] = None
-_registry_lock = threading.Lock()
+_registry_lock = locks.TracedLock("faults.active")
 
 
 def install(spec: str) -> FaultRegistry:

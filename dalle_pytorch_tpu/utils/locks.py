@@ -1,0 +1,424 @@
+"""graftrace runtime half — traced lock wrappers + lock-order witness.
+
+Every lock in the serving/obs stack is constructed through this module
+(``TracedLock`` / ``TracedRLock`` / ``TracedCondition``); graftlint THR001
+flags raw ``threading.Lock()`` construction anywhere else.  The wrappers are
+drop-in: with the witness disarmed they delegate to the underlying primitive
+after a single module-global bool check (the telemetry free-when-off
+contract — test_locks pins the disabled path at a few µs).
+
+Armed (``GRAFT_LOCK_WITNESS=1`` or :func:`arm`), every acquisition records:
+
+* **order edges** — for each lock already held by the acquiring thread, an
+  ``held_name -> new_name`` edge with a count.  :func:`order_report` runs
+  cycle detection over the edge graph; :func:`assert_acyclic` raises
+  :class:`LockOrderError` naming the cycle.  An AB/BA inversion between two
+  threads therefore fails the chaos suites even when the interleaving never
+  actually deadlocked in that run.
+* **contention stats** — per lock name: acquisitions, contended
+  acquisitions (a non-blocking probe failed first), cumulative wait time,
+  cumulative/max held time.  Exported as ``graft_lock_*`` metrics via
+  :func:`publish_metrics` and as ``kind="lock"`` telemetry events via
+  :func:`emit_telemetry`.
+
+Witness internals are guarded by a raw ``threading.Lock`` — the one
+justified THR001 exemption (the witness cannot trace itself).  Re-entrant
+acquisitions of a ``TracedRLock`` record neither self-edges nor nested
+held-time; only the outermost hold is timed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def _env_flag(name: str, default: bool = False) -> bool:
+    # helpers.env_flag semantics (OFF-able: "0"/"false"/"no"/"off"/"" are
+    # False), restated locally: helpers imports jax at module scope and
+    # locks must stay stdlib-only — obs/ and data/ import it at their own
+    # module scope.
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("", "0", "false", "no", "off")
+
+__all__ = [
+    "TracedLock",
+    "TracedRLock",
+    "TracedCondition",
+    "LockOrderError",
+    "arm",
+    "disarm",
+    "armed",
+    "reset",
+    "stats",
+    "order_report",
+    "assert_acyclic",
+    "publish_metrics",
+    "emit_telemetry",
+]
+
+
+class LockOrderError(AssertionError):
+    """Raised by :func:`assert_acyclic` when the acquisition graph has a
+    cycle (a potential AB/BA deadlock observed at runtime)."""
+
+
+# ---------------------------------------------------------------------------
+# witness state (process-global)
+# ---------------------------------------------------------------------------
+
+_armed: bool = _env_flag("GRAFT_LOCK_WITNESS", default=False)
+
+# The witness cannot trace itself: this is the one deliberate raw-lock
+# construction site outside the wrappers.  graftlint THR001 exempts this
+# module by path.
+_state_lock = threading.Lock()
+# (held_name, acquired_name) -> count
+_edges: Dict[Tuple[str, str], int] = {}
+# name -> [acquires, contended, wait_s, held_s, held_max_s]
+_stats: Dict[str, List[float]] = {}
+
+_tls = threading.local()
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = []
+        _tls.stack = stack
+    return stack
+
+
+def arm() -> None:
+    """Enable the witness for this process (tests/CI)."""
+    global _armed
+    _armed = True
+
+
+def disarm() -> None:
+    global _armed
+    _armed = False
+
+
+def armed() -> bool:
+    return _armed
+
+
+def reset() -> None:
+    """Drop all recorded edges and stats (per-test isolation)."""
+    with _state_lock:
+        _edges.clear()
+        _stats.clear()
+
+
+def _record_acquire(name: str, waited_s: float, contended: bool) -> None:
+    stack = _held_stack()
+    with _state_lock:
+        st = _stats.get(name)
+        if st is None:
+            st = [0, 0, 0.0, 0.0, 0.0]
+            _stats[name] = st
+        st[0] += 1
+        if contended:
+            st[1] += 1
+        st[2] += waited_s
+        for held, _t0 in stack:
+            if held == name:  # RLock re-entry: no self-edge
+                continue
+            key = (held, name)
+            _edges[key] = _edges.get(key, 0) + 1
+    stack.append((name, time.perf_counter()))
+
+
+def _record_release(name: str) -> None:
+    stack = _held_stack()
+    # release the most recent hold of this name (LIFO discipline is the
+    # overwhelmingly common case; out-of-order release still accounts the
+    # right entry)
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][0] == name:
+            _name, t0 = stack.pop(i)
+            held = time.perf_counter() - t0
+            with _state_lock:
+                st = _stats.get(name)
+                if st is not None:
+                    st[3] += held
+                    if held > st[4]:
+                        st[4] = held
+            return
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+
+class _TracedBase:
+    """Shared acquire/release plumbing over a ``threading`` primitive."""
+
+    __slots__ = ("name", "_inner", "_depth")
+
+    def __init__(self, name: str, inner) -> None:
+        self.name = name
+        self._inner = inner
+        # per-wrapper nesting depth (RLock re-entry): witness records only
+        # the outermost hold so held-time is wall time, not a nested sum.
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not _armed:
+            if timeout == -1:
+                return self._inner.acquire(blocking)
+            return self._inner.acquire(blocking, timeout)
+        contended = False
+        waited = 0.0
+        got = self._inner.acquire(False)
+        if not got:
+            contended = True
+            if not blocking:
+                return False
+            t0 = time.perf_counter()
+            if timeout == -1:
+                got = self._inner.acquire(True)
+            else:
+                got = self._inner.acquire(True, timeout)
+            waited = time.perf_counter() - t0
+            if not got:
+                return False
+        self._depth += 1
+        if self._depth == 1:
+            _record_acquire(self.name, waited, contended)
+        return True
+
+    def release(self) -> None:
+        if not _armed:
+            self._inner.release()
+            return
+        if self._depth > 0:
+            self._depth -= 1
+            if self._depth == 0:
+                _record_release(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        if locked is not None:
+            return locked()
+        # RLock has no locked() before 3.12; probe non-blocking
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # --- Condition protocol -------------------------------------------
+    # threading.Condition probes its lock for these; without them its
+    # fallbacks misbehave on a re-entrant inner (the owner's non-blocking
+    # probe *succeeds* on an RLock, so the fallback _is_owned reports
+    # "not owned" to the owner).  Delegate to the primitive and keep the
+    # witness's depth/held-stack consistent across wait()'s full
+    # release/re-acquire.
+
+    def _is_owned(self) -> bool:
+        fn = getattr(self._inner, "_is_owned", None)
+        if fn is not None:
+            return fn()
+        if self._inner.acquire(False):  # plain Lock: same as Condition's
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        depth = self._depth
+        if _armed and depth > 0:
+            _record_release(self.name)
+        self._depth = 0
+        fn = getattr(self._inner, "_release_save", None)
+        if fn is not None:
+            return (depth, fn())
+        self._inner.release()
+        return (depth, None)
+
+    def _acquire_restore(self, state) -> None:
+        depth, inner_state = state
+        fn = getattr(self._inner, "_acquire_restore", None)
+        if fn is not None:
+            fn(inner_state)
+        else:
+            self._inner.acquire()
+        self._depth = depth
+        if _armed and depth > 0:
+            _record_acquire(self.name, 0.0, False)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class TracedLock(_TracedBase):
+    """``threading.Lock`` with optional order/contention witness."""
+
+    __slots__ = ()
+
+    def __init__(self, name: str) -> None:
+        super().__init__(name, threading.Lock())
+
+
+class TracedRLock(_TracedBase):
+    """``threading.RLock`` with optional order/contention witness."""
+
+    __slots__ = ()
+
+    def __init__(self, name: str) -> None:
+        super().__init__(name, threading.RLock())
+
+
+def TracedCondition(lock: Optional[_TracedBase] = None,
+                    name: str = "cond") -> threading.Condition:
+    """``threading.Condition`` over a traced lock.
+
+    ``Condition`` only needs ``acquire``/``release``/``__enter__``/
+    ``__exit__`` from its lock (``wait()`` falls back to a full
+    release/re-acquire when the lock lacks ``_release_save``), so handing
+    it a wrapper keeps every acquisition on the witness.
+    """
+    if lock is None:
+        lock = TracedRLock(name)
+    return threading.Condition(lock)
+
+
+# ---------------------------------------------------------------------------
+# reports
+# ---------------------------------------------------------------------------
+
+
+def stats() -> Dict[str, Dict[str, float]]:
+    """Per-lock contention stats: acquires, contended, wait_s, held_s,
+    held_max_s."""
+    with _state_lock:
+        return {
+            name: {
+                "acquires": int(st[0]),
+                "contended": int(st[1]),
+                "wait_s": st[2],
+                "held_s": st[3],
+                "held_max_s": st[4],
+            }
+            for name, st in _stats.items()
+        }
+
+
+def _find_cycle(edges: Dict[Tuple[str, str], int]) -> Optional[List[str]]:
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    parent: Dict[str, str] = {}
+
+    def visit(start: str) -> Optional[List[str]]:
+        stack = [(start, iter(adj.get(start, ())))]
+        color[start] = GREY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                c = color.get(nxt, WHITE)
+                if c == GREY:
+                    cycle = [nxt, node]
+                    cur = node
+                    while cur != nxt:
+                        cur = parent[cur]
+                        cycle.append(cur)
+                    cycle.reverse()
+                    return cycle
+                if c == WHITE:
+                    color[nxt] = GREY
+                    parent[nxt] = node
+                    stack.append((nxt, iter(adj.get(nxt, ()))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                stack.pop()
+        return None
+
+    for start in adj:
+        if color.get(start, WHITE) == WHITE:
+            cycle = visit(start)
+            if cycle is not None:
+                return cycle
+    return None
+
+
+def order_report() -> Dict[str, object]:
+    """Acquisition-order graph + cycle verdict.
+
+    Returns ``{"edges": [(a, b, count), ...], "cycle": [names...] | None,
+    "acyclic": bool}``.
+    """
+    with _state_lock:
+        edges = dict(_edges)
+    cycle = _find_cycle(edges)
+    return {
+        "edges": sorted((a, b, n) for (a, b), n in edges.items()),
+        "cycle": cycle,
+        "acyclic": cycle is None,
+    }
+
+
+def assert_acyclic() -> None:
+    """Raise :class:`LockOrderError` if the observed acquisition-order graph
+    has a cycle.  Standing gate in the chaos suites and fleet_smoke."""
+    rep = order_report()
+    if not rep["acyclic"]:
+        cycle = rep["cycle"]
+        raise LockOrderError(
+            "lock acquisition order cycle (potential deadlock): "
+            + " -> ".join(cycle))  # type: ignore[arg-type]
+
+
+def publish_metrics() -> None:
+    """Export per-lock stats as ``graft_lock_*`` gauges on the active
+    metrics registry (no-op when none is active)."""
+    from dalle_pytorch_tpu.obs import metrics as obs_metrics
+    reg = obs_metrics.active()
+    if reg is None:
+        return
+    for name, st in stats().items():
+        reg.gauge("graft_lock_acquires_total",
+                  "lock acquisitions", lock=name).set(st["acquires"])
+        reg.gauge("graft_lock_contended_total",
+                  "acquisitions that waited", lock=name).set(st["contended"])
+        reg.gauge("graft_lock_wait_seconds_total",
+                  "cumulative acquire wait", lock=name).set(st["wait_s"])
+        reg.gauge("graft_lock_held_seconds_total",
+                  "cumulative held time", lock=name).set(st["held_s"])
+        reg.gauge("graft_lock_held_seconds_max",
+                  "longest single hold", lock=name).set(st["held_max_s"])
+
+
+def emit_telemetry() -> None:
+    """Emit one ``kind="lock"`` telemetry event per lock plus one order-graph
+    event (no-op when telemetry is inactive)."""
+    from dalle_pytorch_tpu.obs import telemetry as obs_telemetry
+    tel = obs_telemetry.get()
+    if tel is None:
+        return
+    for name, st in stats().items():
+        tel.event("lock", name, **st)
+    rep = order_report()
+    cycle = rep["cycle"]
+    tel.event("lock", "order_graph", edges=len(rep["edges"]),
+              acyclic=rep["acyclic"],
+              cycle=" -> ".join(cycle) if cycle else None)

@@ -562,6 +562,100 @@ def test_srv001_pragma_with_reason_suppresses():
                        path="dalle_pytorch_tpu/serve/router.py") == []
 
 
+# --- THR001 --------------------------------------------------------------
+
+
+def test_thr001_raw_lock_construction_flagged():
+    """threading.Lock/RLock/Condition construction (dotted or imported
+    bare) outside utils/locks.py bypasses the graftrace witness."""
+    src = """
+    import threading
+    from threading import RLock, Condition
+    a = threading.Lock()
+    b = RLock()
+    c = Condition()
+    """
+    found = lint(src, select=("THR001",),
+                 path="dalle_pytorch_tpu/serve/router.py")
+    assert rules_of(found) == ["THR001"] * 3
+
+
+def test_thr001_traced_wrappers_events_and_exempt_paths_clean():
+    """Traced wrappers, Events (no ordering to witness), and the two
+    exempt surfaces — locks.py itself and analyzer fixtures — stay
+    clean."""
+    src = """
+    import threading
+    from dalle_pytorch_tpu.utils import locks
+    a = locks.TracedLock("a")
+    b = locks.TracedRLock("b")
+    c = locks.TracedCondition(name="c")
+    e = threading.Event()
+    """
+    assert lint(src, select=("THR001",),
+                path="dalle_pytorch_tpu/serve/router.py") == []
+    raw = "import threading\nx = threading.Lock()\n"
+    for path in ("dalle_pytorch_tpu/utils/locks.py",
+                 "dalle_pytorch_tpu/lint/threads_fixtures.py"):
+        assert lint_source(raw, select=("THR001",), path=path) == [], path
+
+
+def test_thr001_pragma_with_reason_suppresses():
+    src = ("import threading\n"
+           "x = threading.Lock()  "
+           "# graftlint: disable=THR001 (signal-handler side: the witness "
+           "itself must never run under this lock)\n")
+    assert lint_source(src, select=("THR001",),
+                       path="dalle_pytorch_tpu/obs/telemetry.py") == []
+
+
+# --- THR002 --------------------------------------------------------------
+
+
+def test_thr002_sleep_poll_loop_flagged():
+    """A while loop polling shared state with time.sleep in serve/ never
+    wakes early for close/stop — flagged."""
+    src = """
+    import time
+    def wait_ready(self):
+        while not self.ready:
+            time.sleep(0.01)
+    """
+    found = lint(src, select=("THR002",),
+                 path="dalle_pytorch_tpu/serve/router.py")
+    assert rules_of(found) == ["THR002"]
+
+
+def test_thr002_event_wait_and_out_of_scope_clean():
+    """Event-wait pacing (wakes on close) is the fix and stays clean; the
+    same sleep-poll outside serve/ is out of scope."""
+    src = """
+    def wait_ready(self):
+        while not self.ready:
+            self._stop_evt.wait(0.01)
+    """
+    assert lint(src, select=("THR002",),
+                path="dalle_pytorch_tpu/serve/router.py") == []
+    poll = ("import time\n"
+            "def spin(self):\n"
+            "    while not self.ready:\n"
+            "        time.sleep(0.01)\n")
+    for path in ("dalle_pytorch_tpu/utils/faults.py", "tools/monitor.py",
+                 "tests/test_router.py"):
+        assert lint_source(poll, select=("THR002",), path=path) == [], path
+
+
+def test_thr002_pragma_with_reason_suppresses():
+    src = ("import time\n"
+           "def drive(self):\n"
+           "    while self.pending:\n"
+           "        time.sleep(0.001)  "
+           "# graftlint: disable=THR002 (open-loop pacing against the "
+           "local clock, not shared state)\n")
+    assert lint_source(src, select=("THR002",),
+                       path="dalle_pytorch_tpu/serve/scheduler.py") == []
+
+
 # --- engine machinery ----------------------------------------------------
 
 
@@ -1002,7 +1096,7 @@ def test_every_rule_has_fixture_coverage():
     without positive-fixture coverage fails here."""
     covered = {"ENV001", "SEED001", "BACKEND001", "DOT001", "TRACE001",
                "EXC001", "CKPT001", "OBS001", "OBS002", "OBS003", "SRV001",
-               "DON001", "DON002", "MEM001"}
+               "THR001", "THR002", "DON001", "DON002", "MEM001"}
     assert covered == set(RULES)
 
 

@@ -82,6 +82,57 @@ def test_no_entry_freed_while_referenced():
     assert c.acquire((2, 2)) is None
 
 
+def test_concurrent_acquire_evict_release_under_witness():
+    """ISSUE 17 satellite: the refcount discipline holds under real
+    concurrency.  Replica drivers race acquire/insert/release against
+    LRU eviction with the graftrace witness armed — every acquired
+    payload is the right one for its key (no use-after-evict), the
+    hit/miss ledger is exact, every pin is returned, and the observed
+    lock-order graph stays acyclic."""
+    import threading
+
+    from dalle_pytorch_tpu.utils import locks
+
+    locks.reset()
+    locks.arm()
+    try:
+        c = RadixPrefixCache(capacity=4)  # small: constant evict pressure
+        keys = [(i, i + 1, i + 2) for i in range(12)]
+        errors = []
+        acquires = [0] * 8
+
+        def driver(tid):
+            try:
+                for step in range(60):
+                    key = keys[(tid * 7 + step) % len(keys)]
+                    payload = c.acquire(key)
+                    acquires[tid] += 1
+                    if payload is None:
+                        c.insert(key, f"p{key}")  # insert pins for us
+                    else:
+                        assert payload == f"p{key}", (key, payload)
+                    c.release(key)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors.append(e)
+
+        threads = [threading.Thread(target=driver, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == [], errors
+        s = c.stats()
+        assert s["hits"] + s["misses"] == sum(acquires)
+        assert s["pinned"] == 0           # every pin was returned
+        assert s["entries"] <= 4          # evicted back under capacity
+        locks.assert_acyclic()
+        assert locks.stats()["prefix"]["acquires"] > 0
+    finally:
+        locks.disarm()
+        locks.reset()
+
+
 def test_lru_eviction_order_tracks_last_use():
     c = RadixPrefixCache(capacity=2)
     c.insert((1,), "a")

@@ -34,7 +34,7 @@ from dalle_pytorch_tpu.serve import (DEAD, DRAINING, LATENCY, SERVING,
                                      ReplicaDown, RetriesExhausted,
                                      RouterError, ShedError)
 from dalle_pytorch_tpu.serve.router import _Tracked
-from dalle_pytorch_tpu.utils import faults
+from dalle_pytorch_tpu.utils import faults, locks
 
 VCFG = VAEConfig(image_size=16, num_tokens=32, codebook_dim=16, num_layers=2,
                  hidden_dim=8)
@@ -48,7 +48,15 @@ NO_SHED = {LATENCY: 10_000, THROUGHPUT: 10_000}
 @pytest.fixture(autouse=True)
 def _fresh_faults():
     faults.install("")
+    # graftrace witness armed for every chaos row: each test records its
+    # real lock acquisition order and assert_zero_dropped gates on the
+    # graph staying acyclic (an AB/BA inversion fails the row even when
+    # the interleaving never actually deadlocked in that run)
+    locks.reset()
+    locks.arm()
     yield
+    locks.disarm()
+    locks.reset()
     faults.reset()
 
 
@@ -121,6 +129,7 @@ def assert_zero_dropped(router, handles, refs_of):
     audit = router.audit()
     assert audit["balanced"], audit
     assert audit["outstanding"] == 0, audit
+    locks.assert_acyclic()  # the runtime lock-order witness gate
     return audit
 
 
@@ -476,6 +485,66 @@ def test_close_fails_outstanding_futures_typed(small):
     assert router.audit()["outstanding"] == 0
 
 
+# --- thread-safety regressions (graftrace findings) -------------------------
+
+
+def test_concurrent_submit_storm_counters_exact(small):
+    """Regression for the T1 sweep findings: shed / retries_total /
+    resolved_ok / resolved_err were bumped outside the router lock, so a
+    submit storm could lose increments and unbalance the ledger.  With
+    every counter under the lock the sums are EXACT, not approximate."""
+    import threading
+
+    _, _, _, texts, refs = small
+    router = make_router(small, 2)
+    per_thread, n_threads = 8, 4
+    handles = [[] for _ in range(n_threads)]
+
+    def storm(tid):
+        for i in range(per_thread):
+            handles[tid].append(router.submit(texts[(tid + i) % len(texts)]))
+
+    try:
+        threads = [threading.Thread(target=storm, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        flat = [h for row in handles for h in row]
+        order = {h.request_id: (tid + i) % len(texts)
+                 for tid, row in enumerate(handles)
+                 for i, h in enumerate(row)}
+        audit = assert_zero_dropped(
+            router, flat, lambda i: refs[order[flat[i].request_id]])
+        assert audit["submitted"] == n_threads * per_thread
+        assert audit["resolved_ok"] + audit["resolved_err"] \
+            + audit["shed"] == n_threads * per_thread
+    finally:
+        router.close()
+
+
+def test_wait_serving_unblocks_on_close(small):
+    """Regression for the THR002 finding: wait_serving used to sleep-poll
+    shared state, so a close() racing warm-up left the caller spinning out
+    the full timeout.  Waiting on the stop event + checking _closing turns
+    that into a prompt typed error."""
+    import threading
+
+    router = make_router(small, 1, wait=False)
+    try:
+        t = threading.Timer(0.2, router.close)
+        t.start()
+        t0 = time.monotonic()
+        # asks for more replicas than exist: only close() can unblock it
+        with pytest.raises(RouterError, match="closed while waiting"):
+            router.wait_serving(5, timeout_s=WAIT_S)
+        assert time.monotonic() - t0 < WAIT_S / 2
+        t.join()
+    finally:
+        router.close()
+
+
 # --- observability surfaces -------------------------------------------------
 
 
@@ -498,12 +567,15 @@ def test_replica_state_metrics_and_monitor_scrape(small, capsys):
         while router.replica("r1").state != DEAD \
                 and time.monotonic() < deadline:
             time.sleep(0.01)
+        locks.publish_metrics()  # witness armed by _fresh_faults
         text = reg.render()
         assert 'graft_replica_state{replica="r0",state="serving"} 1.0' \
             in text
         assert 'graft_replica_state{replica="r1",state="dead"} 1.0' in text
         assert 'graft_serve_queue_depth{replica="r0"' in text
         assert "graft_router_submitted_total" in text
+        assert 'graft_lock_acquires_total{lock="router"}' in text
+        assert 'graft_lock_held_seconds_max{lock="router"}' in text
 
         # a minimal telemetry lane so the fleet scan has a stream to align
         import sys
@@ -523,6 +595,8 @@ def test_replica_state_metrics_and_monitor_scrape(small, capsys):
         out = capsys.readouterr().out
         assert "replica r0" in out and "state serving" in out
         assert "replica r1" in out and "state dead" in out
+        assert "contended acquires" in out   # graftrace witness rollup
+        assert "lock router:" in out
         assert rc == 0
     finally:
         router.close()

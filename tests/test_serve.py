@@ -32,7 +32,7 @@ from dalle_pytorch_tpu import DALLE, DALLEConfig, VAEConfig
 from dalle_pytorch_tpu.models.dalle import decode_codes, prefill_codes
 from dalle_pytorch_tpu.serve import (LATENCY, THROUGHPUT, GenerationServer,
                                      ServerStopped, SlotArena)
-from dalle_pytorch_tpu.utils import faults
+from dalle_pytorch_tpu.utils import faults, locks
 
 VCFG = VAEConfig(image_size=16, num_tokens=32, codebook_dim=16, num_layers=2,
                  hidden_dim=8)
@@ -41,8 +41,18 @@ VCFG = VAEConfig(image_size=16, num_tokens=32, codebook_dim=16, num_layers=2,
 @pytest.fixture(autouse=True)
 def _fresh_faults():
     faults.install("")
+    # graftrace witness armed for every row; the teardown assert is the
+    # standing gate — any AB/BA lock-order inversion observed during the
+    # test fails it, deadlock or not
+    locks.reset()
+    locks.arm()
     yield
-    faults.reset()
+    try:
+        locks.assert_acyclic()
+    finally:
+        locks.disarm()
+        locks.reset()
+        faults.reset()
 
 
 @pytest.fixture(scope="module")

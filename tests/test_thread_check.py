@@ -1,0 +1,276 @@
+"""graftrace static-analyzer tests (lint/threads.py + tools/thread_check.py).
+
+The load-bearing properties, in order:
+
+* **Teeth** — each of the four analyses catches its deliberately-broken
+  twin in ``lint/threads_fixtures.py`` (T1 unguarded write AND read, T2
+  blocking call under lock, T3 AB/BA order cycle, T4 future resolve /
+  caller callback under lock), and none of them flag the clean twins.
+  An analyzer that can't catch its own fixtures is a rubber stamp.
+* **Repo-clean gate** — the full sweep over the thread-bearing serving
+  stack exits clean at HEAD: every historical finding is fixed or carries
+  a parenthesized graftrace pragma.  This test IS the no-baseline policy.
+* **Pragma grammar** — ``# graftrace: unguarded (reason)`` suppresses T1
+  on that line, ``# graftrace: allow=T2,T4 (reason)`` suppresses the named
+  analyses, and a bare pragma without a parenthesized reason is itself a
+  TP finding.
+* **CLI contract** — exit 0 clean / 1 findings / 2 usage error, --selftest
+  proves the fixtures end-to-end, --json round-trips the findings.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dalle_pytorch_tpu.lint import threads  # noqa: E402
+
+FIXTURES = REPO / "dalle_pytorch_tpu" / "lint" / "threads_fixtures.py"
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return threads.analyze_file(FIXTURES)
+
+
+def analyze(src: str, select=None):
+    return threads.analyze_source(textwrap.dedent(src), "<test>",
+                                  select=select)
+
+
+# --- teeth: broken twins caught ------------------------------------------
+
+
+def test_t1_unguarded_write_caught(fixture_findings):
+    hits = [f for f in fixture_findings
+            if f.code == "T1" and "BrokenUnguardedCounter" in f.message
+            and "written without a lock" in f.message]
+    assert hits, [f.render() for f in fixture_findings]
+
+
+def test_t1_unguarded_read_caught(fixture_findings):
+    hits = [f for f in fixture_findings
+            if f.code == "T1" and "BrokenUnguardedCounter" in f.message
+            and "read without it" in f.message]
+    assert hits
+
+
+def test_t2_blocking_call_under_lock_caught(fixture_findings):
+    hits = [f for f in fixture_findings
+            if f.code == "T2" and "BrokenCompileUnderLock" in f.message]
+    assert hits and "compile" in hits[0].message
+
+
+def test_t3_order_cycle_caught(fixture_findings):
+    hits = [f for f in fixture_findings
+            if f.code == "T3" and "BrokenOrderInversion" in f.message]
+    assert hits
+
+
+def test_t4_resolve_and_callback_under_lock_caught(fixture_findings):
+    resolve = [f for f in fixture_findings
+               if f.code == "T4" and "set_result" in f.message]
+    callback = [f for f in fixture_findings
+                if f.code == "T4" and "on_done" in f.message]
+    assert resolve and callback
+
+
+def test_clean_twins_not_flagged(fixture_findings):
+    dirty = [f for f in fixture_findings if "Clean" in f.message]
+    assert dirty == [], [f.render() for f in dirty]
+
+
+# --- targeted analysis semantics -----------------------------------------
+
+
+def test_t1_write_in_init_is_setup_not_finding():
+    src = """
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+        def bump(self):
+            with self._lock:
+                self.count += 1
+    """
+    assert analyze(src, select=("T1",)) == []
+
+
+def test_t3_self_edge_on_plain_lock_is_guaranteed_deadlock():
+    src = """
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def outer(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """
+    found = analyze(src, select=("T3",))
+    assert found and found[0].code == "T3"
+    assert "re-acquis" in found[0].message or "deadlock" in found[0].message
+
+
+def test_t3_reentrant_self_nesting_clean():
+    src = """
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.RLock()
+        def outer(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """
+    assert analyze(src, select=("T3",)) == []
+
+
+def test_locked_suffix_methods_assume_lock_held():
+    """``*_locked`` helpers are called with the class lock held by
+    convention: their writes are guarded, their blocking calls are T2."""
+    src = """
+    import time, threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+        def bump(self):
+            with self._lock:
+                self._bump_locked()
+        def _bump_locked(self):
+            self.n += 1
+            time.sleep(1)
+    """
+    found = analyze(src)
+    assert [f.code for f in found] == ["T2"]  # the sleep, not the write
+
+
+def test_str_join_not_flagged_as_thread_join():
+    src = """
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.parts = []
+        def render(self):
+            with self._lock:
+                return ", ".join(self.parts)
+    """
+    assert analyze(src, select=("T2",)) == []
+
+
+# --- pragma grammar ------------------------------------------------------
+
+
+def test_pragma_unguarded_with_reason_suppresses_t1():
+    src = """
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.flag = False
+        def set(self):
+            with self._lock:
+                self.flag = True
+        def peek(self):
+            return self.flag  # graftrace: unguarded (atomic bool read)
+    """
+    assert analyze(src, select=("T1",)) == []
+
+
+def test_pragma_allow_suppresses_named_analyses_only():
+    src = """
+    import time, threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def flush(self):
+            with self._lock:
+                time.sleep(0.1)  # graftrace: allow=T2 (lock is the serializer)
+    """
+    assert analyze(src) == []
+
+
+def test_bare_pragma_without_reason_is_tp_finding():
+    src = """
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.flag = False
+        def peek(self):
+            return self.flag  # graftrace: unguarded
+    """
+    found = analyze(src)
+    assert any(f.code == "TP" for f in found)
+
+
+# --- repo-clean gate (the no-baseline policy) ----------------------------
+
+
+def test_repo_sweep_clean_at_head():
+    """Every module on the thread-bearing surface is clean under T1-T4:
+    fixed, or carrying a justified pragma.  No baseline file exists by
+    design — a new finding fails CI until addressed."""
+    sys.path.insert(0, str(REPO / "tools"))
+    import thread_check
+    for rel in thread_check.DEFAULT_TARGETS:
+        findings = threads.analyze_file(REPO / rel)
+        assert findings == [], (rel, [f.render() for f in findings])
+
+
+# --- CLI contract --------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cli():
+    sys.path.insert(0, str(REPO / "tools"))
+    import thread_check
+    return thread_check
+
+
+def test_cli_default_sweep_exits_zero(cli, capsys):
+    assert cli.main([]) == 0
+    out = capsys.readouterr().out
+    assert "PASS" in out and "T1, T2, T3, T4" in out
+
+
+def test_cli_fixtures_exit_one_and_json_roundtrip(cli, tmp_path, capsys):
+    out_json = tmp_path / "findings.json"
+    rc = cli.main([str(FIXTURES), "--json", str(out_json)])
+    assert rc == 1
+    assert "FAIL" in capsys.readouterr().out
+    payload = json.loads(out_json.read_text())
+    assert payload["tool"] == "thread_check"
+    assert set(payload["counts"]) == {"T1", "T2", "T3", "T4"}
+    codes = {f["code"] for f in payload["findings"]}
+    assert codes == {"T1", "T2", "T3", "T4"}
+    assert all(f["line"] > 0 and f["path"] for f in payload["findings"])
+
+
+def test_cli_selftest_passes(cli, capsys):
+    assert cli.main(["--selftest"]) == 0
+    out = capsys.readouterr().out
+    assert "selftest: PASS" in out and "FAIL" not in out
+
+
+def test_cli_select_filters_analyses(cli, tmp_path, capsys):
+    out_json = tmp_path / "t3.json"
+    rc = cli.main([str(FIXTURES), "--select", "T3", "--json",
+                   str(out_json)])
+    assert rc == 1
+    payload = json.loads(out_json.read_text())
+    assert set(payload["counts"]) == {"T3"}
+
+
+def test_cli_usage_errors_exit_two(cli, capsys):
+    assert cli.main(["--select", "T9"]) == 2
+    assert cli.main([str(REPO / "no" / "such" / "file.py")]) == 2

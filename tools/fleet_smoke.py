@@ -51,7 +51,7 @@ from dalle_pytorch_tpu.obs import metrics as obs_metrics  # noqa: E402
 from dalle_pytorch_tpu.obs import telemetry  # noqa: E402
 from dalle_pytorch_tpu.serve import (LATENCY, THROUGHPUT,  # noqa: E402
                                      FleetRouter, Replica, RouterError)
-from dalle_pytorch_tpu.utils import faults  # noqa: E402
+from dalle_pytorch_tpu.utils import faults, locks  # noqa: E402
 
 
 def build_model():
@@ -92,6 +92,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     args.out.mkdir(parents=True, exist_ok=True)
+    # graftrace witness: honors GRAFT_LOCK_WITNESS=1 (the CI fleet row
+    # sets it); armed, every lock acquisition across router + replica
+    # drivers feeds the order graph gated below
+    if locks.armed():
+        locks.reset()
+        print("[fleet_smoke] graftrace lock-order witness armed")
     telemetry.init(args.out / "router", run_id="fleet-router")
     reg = obs_metrics.init()
     metrics_server = (obs_metrics.serve(args.metrics_port, reg)
@@ -173,6 +179,21 @@ def main(argv=None) -> int:
             print(f"[fleet_smoke] {e}", file=sys.stderr)
     for r in replicas:
         r.close()
+    # lock-order witness gate: with GRAFT_LOCK_WITNESS=1 a cycle in the
+    # observed acquisition graph fails the run even when this particular
+    # interleaving never deadlocked; stats/graph land in metrics + stream
+    lock_cycle = None
+    if locks.armed():
+        locks.publish_metrics()
+        locks.emit_telemetry()
+        try:
+            locks.assert_acyclic()
+            rep = locks.order_report()
+            print(f"[fleet_smoke] lock witness: {len(rep['edges'])} order "
+                  f"edge(s), acyclic")
+        except locks.LockOrderError as e:
+            lock_cycle = str(e)
+            print(f"[fleet_smoke] {e}", file=sys.stderr)
     if metrics_server is not None:
         metrics_server.close()
     telemetry.shutdown()
@@ -183,7 +204,7 @@ def main(argv=None) -> int:
     ok = (dropped == 0 and mismatched == 0 and audit["balanced"]
           and audit["outstanding"] == 0 and audit["resolved_ok"] > 0
           and (args.kill_tick == 0 or audit["replica_deaths"] >= 1)
-          and leak is None)
+          and leak is None and lock_cycle is None)
     if ok:
         print(f"[fleet_smoke] PASS: zero dropped futures "
               f"({audit['resolved_ok']} ok, {errors} typed errors, "
@@ -192,7 +213,9 @@ def main(argv=None) -> int:
               "results bit-match the single-server path")
         return 0
     print(f"[fleet_smoke] FAIL: dropped={dropped} mismatched={mismatched} "
-          f"leak={'yes' if leak else 'no'} audit={audit}", file=sys.stderr)
+          f"leak={'yes' if leak else 'no'} "
+          f"lock_cycle={'yes' if lock_cycle else 'no'} audit={audit}",
+          file=sys.stderr)
     return 1
 
 

@@ -202,12 +202,14 @@ _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 
 
 def _scrape_replica_metrics(url: str, timeout: float = 3.0
-                            ) -> dict[str, dict]:
+                            ) -> tuple[dict[str, dict], dict[str, dict]]:
     """GET an endpoint's /metrics and fold the per-replica serve series
     into ``{replica: {state, queue: {slo: depth}, occupancy,
-    bytes_per_token, hbm_headroom}}``.  Only
-    replica-labeled series participate (a single-server trainer's
-    unlabeled gauges are not a fleet)."""
+    bytes_per_token, hbm_headroom}}`` plus the graftrace witness series
+    into ``{lock: {acquires, contended, wait_s, held_s, held_max_s}}``.
+    Only replica-labeled (serve) / lock-labeled (witness) series
+    participate (a single-server trainer's unlabeled gauges are not a
+    fleet)."""
     import urllib.request
 
     target = url if "://" in url else f"http://{url}"
@@ -216,6 +218,14 @@ def _scrape_replica_metrics(url: str, timeout: float = 3.0
     with urllib.request.urlopen(target, timeout=timeout) as resp:
         text = resp.read().decode("utf-8", "replace")
     out: dict[str, dict] = {}
+    locks: dict[str, dict] = {}
+    lock_fields = {
+        "graft_lock_acquires_total": "acquires",
+        "graft_lock_contended_total": "contended",
+        "graft_lock_wait_seconds_total": "wait_s",
+        "graft_lock_held_seconds_total": "held_s",
+        "graft_lock_held_seconds_max": "held_max_s",
+    }
     for line in text.splitlines():
         if line.startswith("#") or not line.strip():
             continue
@@ -224,14 +234,18 @@ def _scrape_replica_metrics(url: str, timeout: float = 3.0
             continue
         name, labelstr, value = m.groups()
         labels = dict(_LABEL_RE.findall(labelstr or ""))
-        rep = labels.get("replica")
-        if rep is None:
-            continue
-        info = out.setdefault(rep, {"queue": {}})
         try:
             v = float(value)
         except ValueError:
             continue
+        lk = labels.get("lock")
+        if lk is not None and name in lock_fields:
+            locks.setdefault(lk, {})[lock_fields[name]] = v
+            continue
+        rep = labels.get("replica")
+        if rep is None:
+            continue
+        info = out.setdefault(rep, {"queue": {}})
         if name == "graft_replica_state" and v == 1.0:
             info["state"] = labels.get("state", "?")
         elif name == "graft_serve_queue_depth":
@@ -242,7 +256,7 @@ def _scrape_replica_metrics(url: str, timeout: float = 3.0
             info["bytes_per_token"] = v
         elif name == "graft_hbm_headroom_bytes":
             info["hbm_headroom"] = v
-    return out
+    return out, locks
 
 
 def _print_replica_metrics(urls: list[str]) -> int:
@@ -251,12 +265,12 @@ def _print_replica_metrics(urls: list[str]) -> int:
     bad = 0
     for url in urls:
         try:
-            reps = _scrape_replica_metrics(url)
+            reps, lock_stats = _scrape_replica_metrics(url)
         except OSError as e:
             print(f"metrics {url}: unreachable ({e})", file=sys.stderr)
             bad += 1
             continue
-        if not reps:
+        if not reps and not lock_stats:
             print(f"metrics {url}: no replica-labeled serve series")
             continue
         for name in sorted(reps):
@@ -283,6 +297,22 @@ def _print_replica_metrics(urls: list[str]) -> int:
                     f"hbm headroom {info['hbm_headroom'] / 2**20:.0f} MiB")
             flag = "  << DOWN" if state == "dead" else ""
             print(f"replica {name} [{url}]: {' '.join(bits)}{flag}")
+        if lock_stats:
+            # graftrace witness rollup: the top held-time locks tell you
+            # WHERE serialization lives; contended acquires tell you who
+            # is paying for it
+            top = sorted(lock_stats.items(),
+                         key=lambda kv: -kv[1].get("held_s", 0.0))[:5]
+            contended = sum(int(st.get("contended", 0))
+                            for st in lock_stats.values())
+            print(f"locks [{url}]: {len(lock_stats)} witnessed, "
+                  f"{contended} contended acquires")
+            for lk, st in top:
+                print(f"  lock {lk}: {int(st.get('acquires', 0))} acquires "
+                      f"({int(st.get('contended', 0))} contended, wait "
+                      f"{st.get('wait_s', 0.0):.3f}s), held "
+                      f"{st.get('held_s', 0.0):.3f}s total / "
+                      f"{st.get('held_max_s', 0.0) * 1e3:.1f}ms max")
     return bad
 
 

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""graftrace CLI — static lock-discipline sweep over the thread-bearing
+modules (the graftspmd of concurrency; analyses live in
+dalle_pytorch_tpu/lint/threads.py).
+
+Usage:
+    python tools/thread_check.py                  # sweep the serving stack
+    python tools/thread_check.py path/to/mod.py   # sweep specific files
+    python tools/thread_check.py --json out.json  # machine-readable findings
+    python tools/thread_check.py --selftest       # prove T1-T4 catch fixtures
+
+Exit codes: 0 clean, 1 findings, 2 usage/parse error.  Pure AST — no jax,
+no imports of the swept modules, milliseconds per run.  Every finding must
+be fixed or carry a parenthesized graftrace pragma; there is no baseline
+file by design.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dalle_pytorch_tpu.lint import threads  # noqa: E402
+
+# The thread-bearing surface: every module where more than one thread
+# touches shared state (PR 6/8/12/16 growth), plus the refcounted prefix
+# cache the drivers share.
+DEFAULT_TARGETS = (
+    "dalle_pytorch_tpu/serve/scheduler.py",
+    "dalle_pytorch_tpu/serve/replica.py",
+    "dalle_pytorch_tpu/serve/router.py",
+    "dalle_pytorch_tpu/serve/prefix.py",
+    "dalle_pytorch_tpu/utils/ckpt_manager.py",
+    "dalle_pytorch_tpu/obs/metrics.py",
+    "dalle_pytorch_tpu/obs/telemetry.py",
+)
+
+
+def run_sweep(paths, select=None, json_out=None) -> int:
+    findings = []
+    for path in paths:
+        p = Path(path)
+        if not p.is_file():
+            print(f"thread_check: no such file: {p}", file=sys.stderr)
+            return 2
+        try:
+            findings.extend(threads.analyze_file(p, select=select))
+        except SyntaxError as e:
+            print(f"thread_check: parse error in {p}: {e}", file=sys.stderr)
+            return 2
+    for f in findings:
+        print(f.render())
+    counts = {}
+    for f in findings:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    if json_out:
+        payload = {
+            "tool": "thread_check",
+            "analyses": list(threads.ANALYSES),
+            "paths": [str(p) for p in paths],
+            "counts": counts,
+            "findings": [
+                {"code": f.code, "path": f.path, "line": f.line,
+                 "message": f.message}
+                for f in findings
+            ],
+        }
+        Path(json_out).write_text(json.dumps(payload, indent=2) + "\n")
+    if findings:
+        summary = ", ".join(f"{c} {code}" for code, c in sorted(
+            counts.items()))
+        print(f"\nthread_check: FAIL — {len(findings)} finding(s) "
+              f"({summary}); fix or annotate with a justified graftrace "
+              f"pragma")
+        return 1
+    print(f"thread_check: PASS — {len(paths)} module(s) clean "
+          f"({', '.join(threads.ANALYSES)})")
+    return 0
+
+
+def selftest() -> int:
+    """Prove T1-T4 have teeth against lint/threads_fixtures.py (the CLI
+    twin of tests/test_thread_check.py)."""
+    fixture = REPO / "dalle_pytorch_tpu/lint/threads_fixtures.py"
+    findings = threads.analyze_file(fixture)
+    failures = 0
+
+    def expect_catch(label, pred):
+        nonlocal failures
+        hits = [f for f in findings if pred(f)]
+        if hits:
+            print(f"PASS {label}: caught ({hits[0].message[:80]}...)")
+        else:
+            print(f"FAIL {label}: broken fixture NOT caught")
+            failures += 1
+
+    expect_catch("T1 unguarded write",
+                 lambda f: f.code == "T1"
+                 and "BrokenUnguardedCounter" in f.message
+                 and "written without a lock" in f.message)
+    expect_catch("T1 unguarded read",
+                 lambda f: f.code == "T1"
+                 and "BrokenUnguardedCounter" in f.message
+                 and "read without it" in f.message)
+    expect_catch("T2 compile under lock",
+                 lambda f: f.code == "T2"
+                 and "BrokenCompileUnderLock" in f.message)
+    expect_catch("T3 AB/BA cycle",
+                 lambda f: f.code == "T3"
+                 and "BrokenOrderInversion" in f.message)
+    expect_catch("T4 future resolve under lock",
+                 lambda f: f.code == "T4"
+                 and "BrokenResolveUnderLock" in f.message
+                 and "set_result" in f.message)
+    expect_catch("T4 caller callback under lock",
+                 lambda f: f.code == "T4"
+                 and "BrokenResolveUnderLock" in f.message
+                 and "on_done" in f.message)
+
+    dirty_twins = [f for f in findings if "Clean" in f.message]
+    if dirty_twins:
+        print(f"FAIL clean twins flagged: {[f.render() for f in dirty_twins]}")
+        failures += 1
+    else:
+        print("PASS clean twins: no findings")
+
+    print(f"\nselftest: {'FAIL' if failures else 'PASS'}")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("paths", nargs="*",
+                        help="files to sweep (default: the thread-bearing "
+                             "serving stack)")
+    parser.add_argument("--select", type=str, default=None,
+                        help="comma-separated analyses to run "
+                             "(default: all of T1,T2,T3,T4)")
+    parser.add_argument("--json", type=str, default=None,
+                        help="write machine-readable findings to this path")
+    parser.add_argument("--selftest", action="store_true",
+                        help="prove each analysis catches its deliberately-"
+                             "broken fixture, then exit")
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    select = None
+    if args.select:
+        select = tuple(s.strip() for s in args.select.split(",") if s.strip())
+        unknown = set(select) - set(threads.ANALYSES) - {"TP"}
+        if unknown:
+            print(f"thread_check: unknown analyses {sorted(unknown)} "
+                  f"(have {threads.ANALYSES})", file=sys.stderr)
+            return 2
+    paths = args.paths or [str(REPO / t) for t in DEFAULT_TARGETS]
+    return run_sweep(paths, select=select, json_out=args.json)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
