@@ -17,9 +17,17 @@ affinity with queue-depth spill, SLO-aware shedding (typed
 ``ShedError``), bounded retries with exponential backoff, drain/join
 riding the rc-74 preemption contract, and an exactly-once future
 resolution audit (zero dropped futures under replica loss).
+
+graftwire (§21) pushes the same seam across a process boundary:
+``wire`` is the stdlib framed-JSON RPC transport (typed failure
+taxonomy, deadline + bounded retry + jittered backoff, ``rpc_send`` /
+``rpc_recv`` fault sites), and ``remote`` pairs a subprocess-side
+``ReplicaServer`` with a router-side ``RemoteReplica`` that presents
+the exact ``Replica`` surface — the router needs no remote-aware code.
 """
 from .engine import ArenaGeometry, SlotArena
 from .prefix import RadixPrefixCache
+from .remote import RemoteReplica, ReplicaServer, spawn_replica
 from .replica import (DEAD, DRAINING, JOINING, SERVING, Replica,
                       ReplicaDown)
 from .router import (FleetRouter, NoHealthyReplica, RequestFailed,
@@ -27,6 +35,9 @@ from .router import (FleetRouter, NoHealthyReplica, RequestFailed,
                      ShedError)
 from .scheduler import (LATENCY, SLO_CLASSES, THROUGHPUT, GenerationServer,
                         ServeHandle, ServerStopped)
+from .wire import (WireClient, WireError, WireProtocolError,
+                   WireRemoteError, WireReset, WireServer, WireTimeout,
+                   WireUnavailable)
 
 __all__ = [
     "ArenaGeometry", "SlotArena", "RadixPrefixCache", "GenerationServer",
@@ -35,4 +46,7 @@ __all__ = [
     "Replica", "ReplicaDown", "JOINING", "SERVING", "DRAINING", "DEAD",
     "FleetRouter", "RouterHandle", "RouterError", "ShedError",
     "RetriesExhausted", "RequestFailed", "NoHealthyReplica",
+    "WireClient", "WireServer", "WireError", "WireTimeout",
+    "WireUnavailable", "WireReset", "WireProtocolError", "WireRemoteError",
+    "RemoteReplica", "ReplicaServer", "spawn_replica",
 ]

@@ -60,6 +60,7 @@ one :meth:`poll` call, which tests drive directly for determinism.
 from __future__ import annotations
 
 import bisect
+import collections
 import concurrent.futures
 import dataclasses
 import heapq
@@ -89,14 +90,21 @@ class ShedError(RouterError):
     """Admission refused NOW (SLO-aware load shedding): this class's
     fleet-wide backlog exceeds its bound, so queueing would only
     manufacture an SLO miss.  Immediate and typed — the caller retries
-    against it (or downgrades class); it never waits."""
+    against it (or downgrades class); it never waits.
+
+    ``retry_after_s`` is the router's own estimate of when the excess
+    backlog will have drained, computed from the recent resolve rate —
+    a caller that waits that long before resubmitting (tools/loadgen.py
+    does) retries into capacity instead of hammering a full queue."""
 
     def __init__(self, msg: str, *, slo: Optional[str] = None,
-                 depth: Optional[int] = None, bound: Optional[int] = None):
+                 depth: Optional[int] = None, bound: Optional[int] = None,
+                 retry_after_s: Optional[float] = None):
         super().__init__(msg)
         self.slo = slo
         self.depth = depth
         self.bound = bound
+        self.retry_after_s = retry_after_s
 
 
 class RetriesExhausted(RouterError):
@@ -182,10 +190,21 @@ class FleetRouter:
 
         self._lock = locks.TracedLock("router")
         self._replicas: Dict[str, Replica] = {}
+        # DRAINING predecessors superseded by a same-name join: out of
+        # the by-name table (the ring can never double-count the name)
+        # but still owed their grace-window accounting in poll()
+        self._retired: List[Replica] = []
         self._tracked: Dict[int, _Tracked] = {}
         self._retries: List[Tuple[float, int]] = []   # heap of (due, rid)
-        self._drains: Dict[str, float] = {}           # name -> grace deadline
+        # drain grace deadlines keyed by OBJECT identity, not name: a
+        # successor joining under the same name must never inherit (or
+        # clobber) its predecessor's deadline
+        self._drains: Dict[int, float] = {}
         self._probe_fail: Dict[str, int] = {}
+        # resolve timestamps (ok or err — either frees capacity): the
+        # drain-rate window behind ShedError.retry_after_s
+        self._resolve_times: collections.deque = collections.deque(
+            maxlen=32)
         self._last_probe = float("-inf")
         self._next_rid = 0
         self._closing = False
@@ -207,12 +226,27 @@ class FleetRouter:
     def add_replica(self, replica: Replica, *, start: bool = True
                     ) -> Replica:
         """Register (and by default start) a replica.  It takes traffic
-        only once its own driver promotes it to SERVING."""
+        only once its own driver promotes it to SERVING.
+
+        A join under a name whose current holder is DRAINING or DEAD is
+        the rolling-restart race: the predecessor RETIRES — it leaves
+        the by-name table (so the hash ring can never carry the name
+        twice) but keeps its identity-keyed drain deadline, and poll()
+        walks it to completion like any other drain."""
         with self._lock:
-            assert replica.name not in self._replicas, replica.name
+            prev = self._replicas.get(replica.name)
+            if prev is not None:
+                assert prev is not replica, \
+                    f"replica {replica.name} already registered"
+                assert prev.state in (DRAINING, DEAD), (
+                    f"replica name {replica.name!r} is still "
+                    f"{prev.state}; drain it before joining a successor")
+                if prev.state == DRAINING:
+                    self._retired.append(prev)
             self._replicas[replica.name] = replica
             self._probe_fail[replica.name] = 0
-        self._emit("router", "replica_join", replica=replica.name)
+        self._emit("router", "replica_join", replica=replica.name,
+                   superseded=prev is not None)
         if start and replica._thread is None:
             replica.start()
         return replica
@@ -273,7 +307,7 @@ class FleetRouter:
             self._monitor.join(timeout=5.0)
             self._monitor = None
         with self._lock:
-            reps = list(self._replicas.values())
+            reps = list(self._replicas.values()) + list(self._retired)
         for r in reps:
             if r.state != DEAD:
                 r.halt(ReplicaDown(f"replica {r.name}: router closed"))
@@ -319,13 +353,16 @@ class FleetRouter:
                         "requests entering the router", slo=slo).inc()
         bound, depth = self._shed_check(slo)
         if bound is not None and depth >= bound:
+            retry_after = self._shed_retry_after(depth, bound)
             err = ShedError(
-                f"shed: {slo} fleet backlog {depth} >= bound {bound}",
-                slo=slo, depth=depth, bound=bound)
+                f"shed: {slo} fleet backlog {depth} >= bound {bound} "
+                f"(retry after {retry_after:.2f}s)",
+                slo=slo, depth=depth, bound=bound,
+                retry_after_s=retry_after)
             with self._lock:
                 self.shed[slo] += 1
             self._emit("router", "shed", rid=rid, slo=slo, depth=depth,
-                       bound=bound)
+                       bound=bound, retry_after_s=round(retry_after, 4))
             if reg is not None:
                 reg.counter("graft_router_shed_total",
                             "requests shed at admission", slo=slo).inc()
@@ -350,6 +387,22 @@ class FleetRouter:
             slots = sum(r.num_slots for r in reps)
             bound = max(1, int(_SHED_FACTORS[slo] * slots))
         return bound, depth
+
+    def _shed_retry_after(self, depth: int, bound: int) -> float:
+        """Backlog-drain-rate hint: (excess depth) / (recent resolve
+        rate), clamped to [10ms, 30s].  With no recent resolutions to
+        rate (cold start, stalled fleet) the hint is a flat 250ms — a
+        guess that keeps the caller honest without a thundering herd."""
+        with self._lock:
+            window = list(self._resolve_times)
+        now = self._time()
+        if len(window) >= 2:
+            span = now - window[0]
+            if span > 0:
+                rate = len(window) / span
+                excess = max(1, depth - bound + 1)
+                return float(min(max(excess / rate, 0.01), 30.0))
+        return 0.25
 
     # --- routing -----------------------------------------------------------
 
@@ -468,6 +521,7 @@ class FleetRouter:
             tracked.resolved = True
             self._tracked.pop(tracked.handle.request_id, None)
             self.resolved_ok += 1
+            self._resolve_times.append(self._time())
         self._emit("router", "resolve", rid=tracked.handle.request_id,
                    replica=tracked.replica, attempts=tracked.attempts,
                    latency_s=self._time() - tracked.handle.submitted_at)
@@ -481,6 +535,7 @@ class FleetRouter:
             tracked.resolved = True
             self._tracked.pop(tracked.handle.request_id, None)
             self.resolved_err += 1
+            self._resolve_times.append(self._time())
         self._emit("router", "fail", rid=tracked.handle.request_id,
                    replica=tracked.replica, attempts=tracked.attempts,
                    error=repr(err))
@@ -520,7 +575,7 @@ class FleetRouter:
         directly for determinism."""
         now = self._time()
         with self._lock:
-            reps = list(self._replicas.values())
+            reps = list(self._replicas.values()) + list(self._retired)
         for r in reps:
             state = r.state
             if state == SERVING and (
@@ -534,22 +589,22 @@ class FleetRouter:
                 self._declare_dead(r, reason=reason)
             elif state == DRAINING:
                 with self._lock:
-                    deadline = self._drains.get(r.name)
+                    deadline = self._drains.get(id(r))
                 # finish_drain/halt join the driver thread — they must run
                 # OUTSIDE the lock (the done-callbacks they trigger take it)
                 if not r.server.busy:
                     left = r.finish_drain()
-                    with self._lock:
-                        self._drains.pop(r.name, None)
+                    self._drain_done(r)
                     self._emit("router", "drain_complete", replica=r.name,
                                in_grace=True, migrated=len(left))
                 elif deadline is not None and now > deadline:
                     unfinished = r.halt(ReplicaDown(
                         f"replica {r.name}: drain grace expired"))
-                    with self._lock:
-                        self._drains.pop(r.name, None)
+                    self._drain_done(r)
                     self._emit("router", "drain_expired", replica=r.name,
                                migrated=len(unfinished))
+            elif state == DEAD:
+                self._drain_done(r)  # retired corpse: drop the accounting
         if now - self._last_probe >= self.probe_every_s:
             self._last_probe = now
             for r in reps:
@@ -569,8 +624,10 @@ class FleetRouter:
                     self._emit("router", "probe_fail", replica=r.name,
                                consecutive=n)
                     if n >= self.probe_failures:
-                        self.drain(r.name,
-                                   reason=f"healthz failed x{n}")
+                        # drain THIS object (not the name): a successor
+                        # may already hold the name in the table
+                        self._drain_replica(
+                            r, reason=f"healthz failed x{n}")
         due: List[int] = []
         with self._lock:
             while self._retries and self._retries[0][0] <= now:
@@ -601,20 +658,33 @@ class FleetRouter:
 
     def drain(self, name: str, *, grace_s: Optional[float] = None,
               reason: str = "operator drain") -> Replica:
-        """Begin draining ``name``: stop admitting, migrate the queued
-        backlog now, give running slots ``grace_s`` (default
-        ``drain_grace_s``) to finish before :meth:`poll` hard-halts and
-        migrates them too — the rc-74 notice/grace/kill contract applied
-        to serving."""
+        """Begin draining ``name``'s CURRENT holder: stop admitting,
+        migrate the queued backlog now, give running slots ``grace_s``
+        (default ``drain_grace_s``) to finish before :meth:`poll`
+        hard-halts and migrates them too — the rc-74 notice/grace/kill
+        contract applied to serving."""
         with self._lock:
             replica = self._replicas[name]
+        return self._drain_replica(replica, grace_s=grace_s, reason=reason)
+
+    def _drain_replica(self, replica: Replica, *,
+                       grace_s: Optional[float] = None,
+                       reason: str = "operator drain") -> Replica:
         grace = self.drain_grace_s if grace_s is None else float(grace_s)
         with self._lock:
-            self._drains[name] = self._time() + grace
-        self._emit("router", "drain_begin", replica=name, grace_s=grace,
-                   reason=reason)
+            self._drains[id(replica)] = self._time() + grace
+        self._emit("router", "drain_begin", replica=replica.name,
+                   grace_s=grace, reason=reason)
         replica.begin_drain(reason=reason)
         return replica
+
+    def _drain_done(self, replica: Replica) -> None:
+        """Forget a finished drain: its identity-keyed deadline and (for
+        a superseded predecessor) its retirement slot."""
+        with self._lock:
+            self._drains.pop(id(replica), None)
+            if replica in self._retired:
+                self._retired.remove(replica)
 
     # --- accounting --------------------------------------------------------
 

@@ -34,6 +34,15 @@ reads, ``sigterm`` in the trainers' step loops).  Actions:
   its 40th driver tick, whatever training step anything else is on.
 * ``grace_ms=N`` — configuration, not a trigger: the grace window (in
   milliseconds) the ``preempt`` site pairs with its ``at_step``.
+* ``drop=N`` / ``conn_reset=N`` — network actions for the RPC transport
+  sites (below): the N-th hit returns the action name to the caller,
+  once — ``serve/wire.py`` turns ``drop`` into a vanished frame (the
+  peer never sees it; the caller's deadline is what notices) and
+  ``conn_reset`` into a torn TCP connection.  Same one-shot return
+  semantics as ``truncate``.
+* ``delay_ms=N`` — configuration like ``grace_ms``: the transport sleeps
+  N milliseconds on every hit of the site (tail-latency injection, the
+  slow-network shape that must surface as deadline misses, not hangs).
 
 Preemption site (both trainers' step loops): ``preempt:at_step=N`` is the
 full preemption drill — :func:`maybe_preempt` delivers a real SIGTERM
@@ -91,6 +100,16 @@ makes the probe fail while the driver keeps beating — the
 probe-signal-without-heartbeat-signal case the router must treat as a
 graceful quarantine, not an instant death.
 
+Network sites (serve/wire.py): ``rpc_send`` fires once per frame a
+``WireClient`` writes, ``rpc_recv`` once per response frame it reads —
+CLIENT-side only, so one in-process fault registry shared by a test's
+client and server injects deterministically at the caller's edge of the
+wire.  ``drop``/``conn_reset``/``truncate`` are one-shot Nth-hit
+actions; ``delay_ms`` is per-hit configuration.  A dropped *send*
+models a lost request (the peer never executed); a dropped *recv*
+models a lost response (the peer DID execute — the ambiguous timeout
+the idempotent-retry contract exists for).
+
 Counters are per-site and thread-safe (dataset reads run under the
 prefetching DataLoader's thread pool).  The registry is parsed lazily from
 the environment; trainers call :func:`install_from_env` at startup so
@@ -109,7 +128,7 @@ from ..obs import telemetry
 from . import locks
 
 _ACTIONS = ("fail_after", "every", "truncate", "at_step", "at_tick",
-            "grace_ms")
+            "grace_ms", "drop", "delay_ms", "conn_reset")
 
 
 class InjectedFault(OSError):
@@ -170,8 +189,8 @@ class FaultRegistry:
             return self._hits.get(site, 0)
 
     def config(self, site: str, action: str) -> Optional[int]:
-        """Value of a configuration action (``grace_ms``) on ``site``, or
-        None when the spec doesn't carry one."""
+        """Value of a configuration action (``grace_ms``/``delay_ms``)
+        on ``site``, or None when the spec doesn't carry one."""
         with self._lock:
             for t in self._triggers.get(site, ()):
                 if t.action == action:
@@ -188,7 +207,7 @@ class FaultRegistry:
             hits = self._hits[site] = self._hits.get(site, 0) + 1
             actions = set()
             for t in self._triggers.get(site, ()):
-                if t.action == "grace_ms":
+                if t.action in ("grace_ms", "delay_ms"):
                     continue  # configuration, read via config(), never fires
                 if t.action == "fail_after":
                     if not t.fired and hits == t.value + 1:
@@ -203,10 +222,13 @@ class FaultRegistry:
                         raise InjectedFault(
                             f"injected fault: {site} hit {hits} "
                             f"(every={t.value})")
-                elif t.action == "truncate":
+                elif t.action in ("truncate", "drop", "conn_reset"):
+                    # one-shot Nth-hit actions returned to the caller:
+                    # the transport (or checkpoint writer) tears its own
+                    # frame/connection so the failure is a REAL one
                     if not t.fired and hits == t.value:
                         t.fired = True
-                        actions.add("truncate")
+                        actions.add(t.action)
                 elif t.action in ("at_step", "at_tick"):
                     # same one-shot progress trigger; at_tick is the
                     # spelling for tick-counter callers (replica drivers)

@@ -63,6 +63,38 @@ def test_truncate_returned_once_to_caller():
     assert reg.fire("ckpt_write") == frozenset()
 
 
+def test_drop_and_conn_reset_returned_once_on_nth_hit():
+    """The rpc transport actions ride the truncate contract: the N-th
+    hit of the site returns the action name to the caller, once —
+    serve/wire.py turns them into a vanished frame / torn connection."""
+    reg = FaultRegistry("rpc_send:drop=2,rpc_recv:conn_reset=1")
+    assert reg.fire("rpc_send") == frozenset()
+    assert reg.fire("rpc_send") == frozenset({"drop"})
+    assert reg.fire("rpc_send") == frozenset()  # one-shot: spent
+    assert reg.fire("rpc_recv") == frozenset({"conn_reset"})
+    assert reg.fire("rpc_recv") == frozenset()
+
+
+def test_delay_ms_is_config_not_trigger():
+    """delay_ms is read via config() (like grace_ms) and never appears
+    in fire() results — the transport sleeps on EVERY hit of the site,
+    it does not consume a one-shot budget."""
+    reg = FaultRegistry("rpc_send:delay_ms=40,rpc_send:drop=2")
+    assert reg.config("rpc_send", "delay_ms") == 40
+    for _ in range(3):
+        assert "delay_ms" not in reg.fire("rpc_send")
+    assert reg.config("rpc_send", "delay_ms") == 40  # still configured
+    assert reg.config("rpc_recv", "delay_ms") is None
+
+
+def test_rpc_actions_compose_with_classic_grammar():
+    reg = FaultRegistry("rpc_send:drop=1,ckpt_write:truncate=1,"
+                        "rpc_send:delay_ms=5")
+    assert reg.fire("rpc_send") == frozenset({"drop"})
+    assert reg.fire("ckpt_write") == frozenset({"truncate"})
+    assert reg.config("rpc_send", "delay_ms") == 5
+
+
 def test_sites_are_independent_and_combinable():
     reg = FaultRegistry("a:every=1,b:truncate=1")
     assert reg.fire("b") == frozenset({"truncate"})

@@ -1,0 +1,87 @@
+"""Trace synthesis for tools/loadgen.py (the chaos-gate's traffic).
+
+Pure-function pins: the trace is fully deterministic under a seed (the
+chaos gate must be replayable bit-for-bit), the diurnal envelope has the
+documented trough-peak-trough shape, and the Zipf skew actually
+concentrates arrivals on the hot prompt the prefix cache banks on.
+"""
+import importlib.util
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+_spec = importlib.util.spec_from_file_location(
+    "loadgen", REPO / "tools" / "loadgen.py")
+loadgen = importlib.util.module_from_spec(_spec)
+sys.modules.setdefault("loadgen", loadgen)
+_spec.loader.exec_module(loadgen)
+
+
+def test_diurnal_envelope_trough_peak_trough():
+    mean, amp = 5.0, 0.6
+    r0 = loadgen.diurnal_rate(0.0, mean, amp)
+    r_quarter = loadgen.diurnal_rate(0.25, mean, amp)
+    r_peak = loadgen.diurnal_rate(0.5, mean, amp)
+    r1 = loadgen.diurnal_rate(0.999, mean, amp)
+    assert r0 == pytest.approx(mean * (1 - amp))
+    assert r_peak == pytest.approx(mean * (1 + amp))
+    assert r_quarter == pytest.approx(mean)
+    assert r1 == pytest.approx(r0, rel=0.05)  # a full cycle closes
+    # never negative, even for amp > 1
+    assert loadgen.diurnal_rate(0.0, 1.0, 2.0) == 0.0
+
+
+def test_zipf_weights_normalized_and_skewed():
+    w = loadgen.zipf_weights(8, 1.1)
+    assert sum(w) == pytest.approx(1.0)
+    assert w == sorted(w, reverse=True)  # rank 0 is the hot prompt
+    assert w[0] > 2 * w[3]  # real skew, not near-uniform
+    flat = loadgen.zipf_weights(8, 0.0)
+    assert all(x == pytest.approx(1 / 8) for x in flat)
+
+
+def test_build_trace_deterministic_under_seed():
+    kw = dict(duration_s=20.0, rate_mean=4.0, rate_amp=0.5, prompts=4,
+              zipf_s=1.1, latency_frac=0.25, seed=7)
+    a = loadgen.build_trace(**kw)
+    b = loadgen.build_trace(**kw)
+    assert a == b  # the replayable-chaos contract
+    c = loadgen.build_trace(**{**kw, "seed": 8})
+    assert a != c  # and the seed actually matters
+    assert len(a) > 20  # ~80 expected arrivals; far above flake floor
+    times = [t for t, _i, _s in a]
+    assert times == sorted(times)
+    assert all(0 <= t < 20.0 for t in times)
+
+
+def test_build_trace_zipf_concentrates_on_hot_prompt():
+    trace = loadgen.build_trace(
+        duration_s=200.0, rate_mean=5.0, rate_amp=0.0, prompts=6,
+        zipf_s=1.2, latency_frac=0.3, seed=0)
+    counts = [0] * 6
+    for _t, idx, _slo in trace:
+        counts[idx] += 1
+    assert counts[0] == max(counts)  # the hot prompt IS rank 0
+    assert counts[0] > 0.3 * len(trace)
+    slos = {slo for _t, _i, slo in trace}
+    assert slos == {"latency", "throughput"}  # mixed SLO classes
+    lat_frac = sum(1 for _t, _i, s in trace if s == "latency") / len(trace)
+    assert 0.2 <= lat_frac <= 0.4  # the Bernoulli mix near its 0.3
+
+
+def test_build_trace_arrivals_follow_diurnal_density():
+    trace = loadgen.build_trace(
+        duration_s=300.0, rate_mean=4.0, rate_amp=0.8, prompts=2,
+        zipf_s=1.0, latency_frac=0.5, seed=3)
+    mid = [t for t, _i, _s in trace if 100.0 <= t < 200.0]
+    edges = [t for t, _i, _s in trace if t < 100.0 or t >= 200.0]
+    # the middle third holds the peak: strictly denser than the edges
+    assert len(mid) > len(edges) / 2 * 1.5
+
+
+def test_build_trace_zero_rate_is_empty():
+    assert loadgen.build_trace(
+        duration_s=10.0, rate_mean=0.0, rate_amp=0.0, prompts=2,
+        zipf_s=1.0, latency_frac=0.5, seed=0) == []

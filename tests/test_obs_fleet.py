@@ -508,3 +508,84 @@ def test_live_vae_run_with_metrics_port_and_alerts(tmp_path, monkeypatch):
     with pytest.raises(OSError):
         urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
                                timeout=2)
+
+
+# --- subprocess replica lanes (graftwire, ISSUE 18) ------------------------
+
+
+def test_subprocess_lane_merges_with_parent_timeline(tmp_path, monkeypatch):
+    """The process-remote shape tools/loadgen.py merges: a REAL child
+    process writes its own telemetry lane (own boot nonce, own
+    rendezvous beacons against the shared clock dir) and merge_streams
+    folds it into the parent's timeline — per-class serve rows span the
+    process boundary as if one host had served everything."""
+    import subprocess
+
+    monkeypatch.setenv("GRAFT_CLOCK_RDV", str(tmp_path / "rdv"))
+    parent = telemetry.Telemetry(tmp_path / "parent", run_id="parent")
+    parent.event("serve", "retire", rid=1, slo="latency", latency_s=0.5,
+                 queue_wait_s=0.01, slo_ok=True, tokens=4)
+    parent.close()
+    child_src = (
+        "import sys; sys.path.insert(0, sys.argv[1])\n"
+        "from dalle_pytorch_tpu.obs import telemetry\n"
+        "t = telemetry.Telemetry(sys.argv[2], run_id='child')\n"
+        "t.event('serve', 'retire', rid=2, slo='latency', latency_s=2.0,\n"
+        "        queue_wait_s=0.02, slo_ok=False, tokens=4)\n"
+        "t.event('serve', 'retire', rid=3, slo='throughput',\n"
+        "        latency_s=1.0, queue_wait_s=0.0, slo_ok=True, tokens=4)\n"
+        "t.close()\n"
+    )
+    subprocess.run([sys.executable, "-c", child_src, str(REPO),
+                    str(tmp_path / "child")], check=True, timeout=60)
+    events, clocks = merge_streams([tmp_path / "parent",
+                                    tmp_path / "child"])
+    # two lanes, each aligned via the SHARED fs rendezvous — the only
+    # anchor two processes with no common workload can both see
+    assert len(clocks) == 2
+    assert all(c.method == "rendezvous" for c in clocks)
+    boots = {e.get("boot") for e in events if e.get("boot")}
+    assert len(boots) == 2  # distinct per-process boot nonces survive
+    rep = build_fleet_report(events, clocks)
+    by_class = rep["serve"]["by_class"]
+    # the latency row spans BOTH processes: parent's hit + child's miss
+    assert by_class["latency"]["completed"] == 2
+    assert by_class["latency"]["attainment"] == pytest.approx(0.5)
+    assert by_class["throughput"]["completed"] == 1
+    assert by_class["throughput"]["attainment"] == pytest.approx(1.0)
+
+
+def test_obs_report_cli_merges_subprocess_lane_with_fixture(tmp_path,
+                                                           capsys,
+                                                           monkeypatch):
+    """obs_report --merge over the committed 3-host fixture PLUS a
+    freshly written subprocess-shaped lane: the CLI path the CI
+    loadgen_smoke artifact step runs."""
+    import subprocess
+
+    monkeypatch.setenv("GRAFT_CLOCK_RDV", str(tmp_path / "rdv"))
+    child_src = (
+        "import sys; sys.path.insert(0, sys.argv[1])\n"
+        "from dalle_pytorch_tpu.obs import telemetry\n"
+        "t = telemetry.Telemetry(sys.argv[2], run_id='sub')\n"
+        "t.event('serve', 'retire', rid=9, slo='latency', latency_s=0.3,\n"
+        "        queue_wait_s=0.0, slo_ok=True, tokens=4)\n"
+        "t.close()\n"
+    )
+    subprocess.run([sys.executable, "-c", child_src, str(REPO),
+                    str(tmp_path / "sub")], check=True, timeout=60)
+    sys.path.insert(0, str(REPO / "tools"))
+    import obs_report
+
+    assert obs_report.main(
+        ["--merge"] + [str(d) for d in FLEET_DIRS]
+        + [str(tmp_path / "sub")]) == 0
+    out = capsys.readouterr().out
+    assert "-- fleet (aligned timebase) --" in out
+    events, clocks = merge_streams(FLEET_DIRS + [tmp_path / "sub"])
+    assert len(clocks) == 4  # 3 fixture hosts + the subprocess lane
+    rep = build_fleet_report(events, clocks)
+    # fixture had 5 latency retires (4 ok), the child adds 1 ok
+    assert rep["serve"]["by_class"]["latency"]["completed"] == 6
+    assert rep["serve"]["by_class"]["latency"]["attainment"] == \
+        pytest.approx(5 / 6)

@@ -658,3 +658,113 @@ def test_fleet_smoke_tool_multi_process(tmp_path):
          str(out / "router"), str(out / "replica0"), str(out / "replica1")],
         capture_output=True, text=True, timeout=300)
     assert merge.returncode == 0, merge.stdout + merge.stderr
+
+
+# --- drain/join race + shed retry hints (graftwire, ISSUE 18) ---------------
+
+
+def test_same_name_join_during_drain_never_double_rings(small):
+    """The rolling-restart race pinned: a successor joining under a name
+    whose predecessor is still DRAINING must (a) be accepted, (b) leave
+    the hash ring carrying the name's vnodes EXACTLY once, and (c) let
+    the predecessor drain to completion off-ring — never an assert
+    crash, never a request routed to the corpse."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 2)
+    try:
+        old = router.replica("r0")
+        router.drain("r0")
+        assert old.state in (DRAINING, DEAD)
+        successor = make_replica(small, "r0")
+        router.join(successor)  # the race: same name, prev still draining
+        # by-name table holds ONLY the successor...
+        assert router.replica("r0") is successor
+        # ...so the ring carries r0's vnodes exactly once
+        ring_names = [nm for _, nm in
+                      router._ring_for(list(router._replicas.values()))]
+        assert ring_names.count("r0") == router.virtual_nodes
+        # the predecessor retires but is still WALKED: poll() drives its
+        # drain to DEAD and then forgets it
+        if old.state == DRAINING:
+            assert old in router._retired
+        deadline = time.monotonic() + WAIT_S
+        while old.state != DEAD:
+            assert time.monotonic() < deadline, old.state
+            router.poll()
+            time.sleep(0.02)
+        deadline = time.monotonic() + WAIT_S
+        while old in router._retired:
+            assert time.monotonic() < deadline
+            router.poll()
+            time.sleep(0.02)
+        router.wait_serving(2, timeout_s=WAIT_S)
+        # traffic lands on the successor, bit-exact
+        hs = [router.submit(texts[i % len(texts)]) for i in range(4)]
+        assert_zero_dropped(router, hs, lambda i: refs[i % len(texts)])
+    finally:
+        router.close()
+
+
+def test_drain_join_storm_no_crash_and_single_ring_entry(small):
+    """Adversarial interleave: drain fired from a prober-like thread
+    while the join races it — repeated; the by-name invariant and the
+    assert in add_replica must hold every round."""
+    router = make_router(small, 1, names=["rx"])
+    try:
+        for _round in range(3):
+            router.drain("rx")
+            successor = make_replica(small, "rx")
+            router.join(successor)
+            assert router.replica("rx") is successor
+            ring_names = [nm for _, nm in
+                          router._ring_for([successor])]
+            assert ring_names.count("rx") == router.virtual_nodes
+            deadline = time.monotonic() + WAIT_S
+            while any(r.state != DEAD for r in router._retired):
+                assert time.monotonic() < deadline
+                router.poll()
+                time.sleep(0.02)
+            router.wait_serving(1, timeout_s=WAIT_S)
+    finally:
+        router.close()
+
+
+def test_shed_error_carries_backlog_drain_rate_hint(small):
+    """ShedError.retry_after_s: populated, clamped, and scaled from the
+    router's own recent resolve rate — the hint tools/loadgen.py sleeps
+    on before resubmitting."""
+    _, _, _, texts, refs = small
+    router = make_router(small, 1, shed_bounds={LATENCY: 1, THROUGHPUT: 1})
+    try:
+        # prime the resolve-rate window with real completions
+        warm = [router.submit(texts[0]) for _ in range(2)]
+        assert_zero_dropped(router, warm, lambda i: refs[0])
+        # saturate: bound 1 → the burst sheds, each with a hint
+        hs = [router.submit(texts[i % len(texts)]) for i in range(10)]
+        sheds = [h.future.exception() for h in hs
+                 if isinstance(h.future.exception(), ShedError)]
+        assert sheds, "bound=1 burst produced no sheds"
+        for exc in sheds:
+            assert exc.retry_after_s is not None
+            assert 0.01 <= exc.retry_after_s <= 30.0
+            # the hint is the rate estimate, not the flat fallback: the
+            # primed window (2 resolves) makes it depth/rate-shaped
+            assert exc.depth >= exc.bound
+        for h in hs:  # settle the survivors
+            if not h.future.done():
+                h.future.exception(WAIT_S)
+    finally:
+        router.close()
+
+
+def test_shed_retry_after_cold_start_fallback(small):
+    """No resolves yet → the hint is the flat 250ms guess, not a div by
+    zero and not an unbounded wait."""
+    router = make_router(small, 1, shed_bounds={LATENCY: 0, THROUGHPUT: 0})
+    try:
+        h = router.submit(np.zeros(6, np.int32))
+        exc = h.future.exception(WAIT_S)
+        assert isinstance(exc, ShedError)
+        assert exc.retry_after_s == pytest.approx(0.25)
+    finally:
+        router.close()

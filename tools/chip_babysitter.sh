@@ -168,6 +168,28 @@ echo "$(date +%T) spmd check PASS"
 # checkpoint manifests record the written-under plan and the restore
 # reshards onto the new one (rc=74 PREEMPT_EXPIRED, like rc=75, is a
 # transient death that resumes from the last committed manifest).
+# probe_healthz PORT: the /healthz liveness probe, retried with the SAME
+# policy constants as the graftwire transport (serve/wire.py:
+# RETRY_ATTEMPTS=3, BACKOFF_BASE_S=0.05 doubling) — one blip on a busy
+# box is not a wedge, three in a row across ~0.35s of backoff is a
+# signal worth logging.  Returns 0 on any success, 1 after the budget.
+probe_healthz() {
+  port=$1
+  backoff=0.05
+  attempt=1
+  while [ "$attempt" -le 3 ]; do
+    if curl -sf -m 5 "http://127.0.0.1:${port}/healthz" >/dev/null 2>&1; then
+      return 0
+    fi
+    if [ "$attempt" -lt 3 ]; then
+      sleep "$backoff"
+      backoff=$(awk "BEGIN{print ${backoff}*2}")
+    fi
+    attempt=$((attempt + 1))
+  done
+  return 1
+}
+
 if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
   BABYSIT_HB_DIR=${BABYSIT_HB_DIR:-${CHIP_TMP}/train_hb}
   BABYSIT_MAX_RESTARTS=${BABYSIT_MAX_RESTARTS:-3}
@@ -211,8 +233,8 @@ if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
       while kill -0 "$train_pid" 2>/dev/null; do
         sleep "$BABYSIT_POLL"
         if [ "${BABYSIT_METRICS_PORT}" -gt 0 ]; then
-          if ! curl -sf -m 5 "http://127.0.0.1:${BABYSIT_METRICS_PORT}/healthz" >/dev/null 2>&1; then
-            echo "$(date +%T) train supervisor: /healthz probe FAILED (pid alive; heartbeat scan decides the restart)"
+          if ! probe_healthz "${BABYSIT_METRICS_PORT}"; then
+            echo "$(date +%T) train supervisor: /healthz probe FAILED 3x with backoff (pid alive; heartbeat scan decides the restart)"
           fi
         fi
         python tools/monitor.py "${BABYSIT_HB_DIR}" \

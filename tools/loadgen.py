@@ -1,0 +1,409 @@
+#!/usr/bin/env python
+"""graftwire loadgen: trace-driven SLO chaos gate over a subprocess fleet.
+
+The proving harness for ISSUE 18 (ROADMAP directions 2c + 2e): open-loop
+traffic with a realistic shape — a diurnal rate curve compressed into
+``--duration``, Zipf hot-prompt skew (the PR 16 prefix cache's reason to
+exist), mixed SLO classes — replayed against ``--replicas`` REAL
+subprocess replicas behind a :class:`FleetRouter`, while a chaos
+schedule SIGKILLs one replica mid-trace, joins a same-name successor
+under traffic, and injects rpc-transport faults
+(``rpc_send``/``rpc_recv`` drop / delay_ms / conn_reset) at the
+router's edge of the wire.  Open-loop means arrivals NEVER wait for
+completions — backpressure surfaces as shedding, not as a politely
+self-throttling load generator.
+
+Shed handling honors the router's hint: a :class:`ShedError` carries
+``retry_after_s`` (computed from the fleet's resolve rate) and the
+loadgen resubmits after exactly that wait, up to ``--shed-retries``
+times, reporting the shed-retry success rate.
+
+Exit 0 iff ALL of:
+
+* zero dropped futures (every arrival resolves: codes, shed that
+  exhausted its retries, or a typed RouterError);
+* the router audit ledger balances with nothing outstanding (and the
+  kill was actually observed as a replica death);
+* every successful result BIT-MATCHES the single-server greedy
+  reference for its prompt — across migration, dedup, and restart;
+* per-SLO-class attainment, read from the MERGED fleet telemetry
+  (router lane + one lane per child process), meets ``--attain``.
+
+Usage (the CI ``loadgen_smoke`` row)::
+
+    python tools/loadgen.py --replicas 3 --duration 12 --kill-frac 0.35 \
+        --restart-frac 0.6 --out loadgen-smoke
+    python tools/obs_report.py --merge loadgen-smoke/router \
+        loadgen-smoke/r* loadgen-smoke/gen2/*
+"""
+from __future__ import annotations
+
+import argparse
+import bisect
+import heapq
+import itertools
+import json
+import math
+import random
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dalle_pytorch_tpu.cli import apply_platform_env  # noqa: E402
+
+# CPU harness by contract (same as fleet_smoke): never let a wedged
+# accelerator tunnel hang the chaos gate
+apply_platform_env()
+
+import os  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from dalle_pytorch_tpu.models.dalle import (decode_codes,  # noqa: E402
+                                            prefill_codes)
+from dalle_pytorch_tpu.obs import build_fleet_report  # noqa: E402
+from dalle_pytorch_tpu.obs import merge_streams  # noqa: E402
+from dalle_pytorch_tpu.obs import metrics as obs_metrics  # noqa: E402
+from dalle_pytorch_tpu.obs import telemetry  # noqa: E402
+from dalle_pytorch_tpu.serve import (LATENCY, THROUGHPUT,  # noqa: E402
+                                     FleetRouter, RouterError, ShedError)
+from dalle_pytorch_tpu.serve import remote as serve_remote  # noqa: E402
+from dalle_pytorch_tpu.utils import faults, locks  # noqa: E402
+
+
+# --- trace synthesis (pure; tests/test_loadgen.py pins these) --------------
+
+
+def diurnal_rate(t_frac: float, mean: float, amp: float) -> float:
+    """Arrival rate (req/s) at trace fraction ``t_frac`` in [0,1): one
+    full diurnal cycle compressed into the trace — trough at the edges,
+    peak in the middle, ``mean*(1±amp)`` swing."""
+    return max(0.0, mean * (1.0 + amp * math.sin(
+        2.0 * math.pi * t_frac - math.pi / 2.0)))
+
+
+def zipf_weights(n: int, s: float):
+    """Normalized Zipf(s) over ``n`` ranks: the hot-prompt skew (rank 0
+    is the hot prompt the prefix cache should keep winning on)."""
+    w = [1.0 / float(i + 1) ** s for i in range(n)]
+    total = sum(w)
+    return [x / total for x in w]
+
+
+def build_trace(*, duration_s: float, rate_mean: float, rate_amp: float,
+                prompts: int, zipf_s: float, latency_frac: float,
+                seed: int):
+    """Deterministic open-loop arrival schedule:
+    ``[(t_s, prompt_idx, slo), ...]`` sorted by time.  Thinning sampler
+    against the diurnal envelope, Zipf prompt choice, Bernoulli SLO
+    class mix — all from one seeded RNG so a seed pins the whole
+    trace."""
+    rng = random.Random(seed)
+    peak = rate_mean * (1.0 + abs(rate_amp))
+    if peak <= 0:
+        return []
+    cum = list(itertools.accumulate(zipf_weights(prompts, zipf_s)))
+    out = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= duration_s:
+            return out
+        # thinning: accept with prob rate(t)/peak -> inhomogeneous Poisson
+        if rng.random() * peak <= diurnal_rate(
+                t / duration_s, rate_mean, rate_amp):
+            idx = bisect.bisect_left(cum, rng.random())
+            slo = LATENCY if rng.random() < latency_frac else THROUGHPUT
+            out.append((t, min(idx, prompts - 1), slo))
+
+
+# --- the gate ---------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--replicas", type=int, default=3)
+    parser.add_argument("--slots", type=int, default=2)
+    parser.add_argument("--duration", type=float, default=12.0,
+                        help="trace length in wall seconds (one compressed "
+                             "diurnal cycle)")
+    parser.add_argument("--rate-mean", type=float, default=5.0)
+    parser.add_argument("--rate-amp", type=float, default=0.6)
+    parser.add_argument("--prompts", type=int, default=4)
+    parser.add_argument("--zipf-s", type=float, default=1.1)
+    parser.add_argument("--latency-frac", type=float, default=0.25)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--kill-frac", type=float, default=0.35,
+                        help="SIGKILL replica --kill-index at this trace "
+                             "fraction (<0 disables)")
+    parser.add_argument("--kill-index", type=int, default=1)
+    parser.add_argument("--restart-frac", type=float, default=0.6,
+                        help="join a same-name successor at this fraction "
+                             "(<0 disables)")
+    parser.add_argument("--faults",
+                        default="rpc_send:drop=5,rpc_recv:drop=11,"
+                                "rpc_send:conn_reset=17,rpc_send:delay_ms=2",
+                        help="GRAFT_FAULTS spec installed at --faults-frac "
+                             "(client-side rpc sites; children stay clean)")
+    parser.add_argument("--faults-frac", type=float, default=0.15)
+    parser.add_argument("--faults-clear-frac", type=float, default=0.85)
+    parser.add_argument("--shed-retries", type=int, default=3)
+    parser.add_argument("--slo-latency", type=float, default=30.0,
+                        help="latency-class target (s) the children judge "
+                             "retirements against")
+    parser.add_argument("--slo-throughput", type=float, default=120.0)
+    parser.add_argument("--attain", type=float, default=0.7,
+                        help="per-class SLO attainment floor (from merged "
+                             "telemetry)")
+    parser.add_argument("--prefix-cache", action="store_true", default=True)
+    parser.add_argument("--no-prefix-cache", dest="prefix_cache",
+                        action="store_false")
+    parser.add_argument("--out", type=Path, default=Path("loadgen-out"))
+    parser.add_argument("--timeout", type=float, default=420.0,
+                        help="bound on the whole run (spawn + trace + "
+                             "settle), seconds")
+    args = parser.parse_args(argv)
+
+    args.out.mkdir(parents=True, exist_ok=True)
+    # shared-file clock rendezvous: each child lane beacons against the
+    # same directory, so the merged fleet timeline aligns process-remote
+    # lanes with no common workload anchor
+    os.environ.setdefault("GRAFT_CLOCK_RDV", str(args.out / "clockrdv"))
+    if locks.armed():
+        locks.reset()
+        print("[loadgen] graftrace lock-order witness armed")
+    telemetry.init(args.out / "router", run_id="loadgen-router")
+    obs_metrics.init()
+    faults.install("")  # chaos installs its spec mid-trace, client-side
+
+    # single-server greedy references (the bit-match baseline) from the
+    # SAME toy geometry the children build
+    cfg, dalle, params, texts = serve_remote._build_toy_model(
+        seed=0, prompts=args.prompts)
+    prefill = jax.jit(lambda p, t: prefill_codes(dalle, p, t))
+    refs = []
+    for t in texts:
+        fl, caches = prefill(params, jnp.asarray(t)[None])
+        refs.append(np.asarray(decode_codes(
+            dalle, params, fl, caches, jax.random.PRNGKey(7),
+            filter_thres=1.0))[0])
+    print(f"[loadgen] references ready ({len(refs)} prompts)")
+
+    slo_targets = {LATENCY: args.slo_latency,
+                   THROUGHPUT: args.slo_throughput}
+    t_spawn = time.monotonic()
+    remotes = []
+    for i in range(args.replicas):
+        remotes.append(serve_remote.spawn_replica(
+            f"r{i}", out_dir=args.out, slots=args.slots, host_index=i + 1,
+            slo_targets=slo_targets, prefix_cache=args.prefix_cache,
+            remote_stale_s=5.0,
+            ready_timeout_s=max(60.0, args.timeout / 2)))
+        print(f"[loadgen] replica r{i} up (pid "
+              f"{remotes[-1].proc.pid}, port {remotes[-1]._client.port})")
+    router = FleetRouter(
+        remotes, retry_backoff_s=0.05, retry_backoff_cap_s=0.5,
+        heartbeat_timeout_s=3.0, monitor_interval_s=0.02,
+        probe_every_s=0.25, drain_grace_s=15.0).start()
+    router.wait_serving(args.replicas,
+                        timeout_s=max(30.0, args.timeout / 2))
+    print(f"[loadgen] {args.replicas} subprocess replicas serving "
+          f"({time.monotonic() - t_spawn:.1f}s to warm)")
+
+    trace = build_trace(
+        duration_s=args.duration, rate_mean=args.rate_mean,
+        rate_amp=args.rate_amp, prompts=args.prompts, zipf_s=args.zipf_s,
+        latency_frac=args.latency_frac, seed=args.seed)
+    print(f"[loadgen] trace: {len(trace)} arrivals over "
+          f"{args.duration:.0f}s (peak ~"
+          f"{args.rate_mean * (1 + args.rate_amp):.1f}/s)")
+
+    # chaos timeline (trace fractions -> absolute trace seconds)
+    t_kill = (args.kill_frac * args.duration
+              if 0 <= args.kill_frac <= 1 else None)
+    t_restart = (args.restart_frac * args.duration
+                 if 0 <= args.restart_frac <= 1 else None)
+    t_faults_on = (args.faults_frac * args.duration
+                   if args.faults and 0 <= args.faults_frac <= 1 else None)
+    t_faults_off = (args.faults_clear_frac * args.duration
+                    if 0 <= args.faults_clear_frac <= 1 else None)
+    kill_name = f"r{args.kill_index}"
+
+    handles = []            # (handle, prompt_idx, shed_tries)
+    resubmits: list = []    # heap of (due_t, prompt_idx, slo, tries)
+    shed_first = 0
+    shed_retry_ok = 0       # filled in after the wait loop
+    shed_exhausted = 0
+
+    def submit_one(idx: int, slo: str, tries: int, now_t: float) -> None:
+        nonlocal shed_first, shed_exhausted
+        h = router.submit(texts[idx], slo=slo)
+        if h.future.done():
+            exc = h.future.exception()
+            if isinstance(exc, ShedError):
+                if tries == 0:
+                    shed_first += 1
+                if tries < args.shed_retries:
+                    wait = exc.retry_after_s or 0.25
+                    heapq.heappush(resubmits,
+                                   (now_t + wait, idx, slo, tries + 1))
+                    return  # the resubmit carries this arrival forward
+                shed_exhausted += 1
+        handles.append((h, idx, tries))
+
+    start = time.monotonic()
+    i = 0
+    new_remote = None
+    while True:
+        now_t = time.monotonic() - start
+        if t_kill is not None and now_t >= t_kill:
+            t_kill = None
+            victim = next(r for r in remotes if r.name == kill_name)
+            victim.proc.kill()
+            print(f"[loadgen] CHAOS t={now_t:.2f}s: SIGKILL {kill_name} "
+                  f"(pid {victim.proc.pid})")
+        if t_restart is not None and now_t >= t_restart:
+            t_restart = None
+            # same NAME, fresh process + fresh lane dir: the rolling
+            # restart join the router's supersede path exists for
+            new_remote = serve_remote.spawn_replica(
+                kill_name, out_dir=args.out / "gen2", slots=args.slots,
+                host_index=args.replicas + 1, slo_targets=slo_targets,
+                prefix_cache=args.prefix_cache, remote_stale_s=5.0,
+                ready_timeout_s=max(60.0, args.timeout / 2))
+            router.join(new_remote)
+            print(f"[loadgen] CHAOS t={now_t:.2f}s: joined successor "
+                  f"{kill_name} (pid {new_remote.proc.pid})")
+        if t_faults_on is not None and now_t >= t_faults_on:
+            t_faults_on = None
+            faults.install(args.faults)
+            print(f"[loadgen] CHAOS t={now_t:.2f}s: rpc faults armed: "
+                  f"{args.faults}")
+        if t_faults_off is not None and now_t >= t_faults_off:
+            t_faults_off = None
+            faults.install("")
+            print(f"[loadgen] CHAOS t={now_t:.2f}s: rpc faults cleared")
+        while resubmits and resubmits[0][0] <= now_t:
+            _due, idx, slo, tries = heapq.heappop(resubmits)
+            submit_one(idx, slo, tries, now_t)
+        while i < len(trace) and trace[i][0] <= now_t:
+            _t, idx, slo = trace[i]
+            i += 1
+            submit_one(idx, slo, 0, now_t)
+        if i >= len(trace) and not resubmits and t_restart is None \
+                and t_faults_off is None:
+            break
+        nexts = [trace[i][0] if i < len(trace) else None,
+                 resubmits[0][0] if resubmits else None,
+                 t_kill, t_restart, t_faults_on, t_faults_off]
+        pending = [x for x in nexts if x is not None]
+        if not pending and i >= len(trace) and not resubmits:
+            break
+        time.sleep(max(0.001, min(
+            (min(pending) - (time.monotonic() - start)) if pending
+            else 0.005, 0.05)))
+    faults.install("")  # settle phase: no injection while draining
+    print(f"[loadgen] trace replayed: {len(handles)} admitted, "
+          f"{shed_first} shed at first touch, "
+          f"{shed_exhausted} shed past the retry budget")
+
+    deadline = start + args.duration + args.timeout
+    dropped = 0
+    mismatched = 0
+    typed_errors = 0
+    ok_count = 0
+    shed_final = 0
+    for h, idx, tries in handles:
+        try:
+            out = h.result(max(0.1, deadline - time.monotonic()))
+            ok_count += 1
+            if tries > 0:
+                shed_retry_ok += 1
+            if not np.array_equal(out, refs[idx]):
+                mismatched += 1
+        except ShedError:
+            shed_final += 1
+        except RouterError:
+            typed_errors += 1  # typed resolution: counted, never a drop
+        # graftlint: disable=EXC001 (the gate itself: any untyped resolution or timeout IS the dropped future this harness hunts; counted, fails the run loudly)
+        except Exception:
+            dropped += 1
+
+    audit = router.audit()
+    states = {n: r["state"] for n, r in router.stats()["replicas"].items()}
+    retry_rate = (shed_retry_ok / shed_first) if shed_first else None
+    router.close()
+    lock_cycle = None
+    if locks.armed():
+        locks.publish_metrics()
+        locks.emit_telemetry()
+        try:
+            locks.assert_acyclic()
+            rep = locks.order_report()
+            print(f"[loadgen] lock witness: {len(rep['edges'])} order "
+                  f"edge(s), acyclic")
+        except locks.LockOrderError as e:
+            lock_cycle = str(e)
+            print(f"[loadgen] {e}", file=sys.stderr)
+    telemetry.shutdown()
+    faults.reset()
+
+    # --- merged-telemetry SLO gate ---
+    lanes = [args.out / "router"]
+    lanes += [args.out / f"r{j}" for j in range(args.replicas)]
+    if new_remote is not None:
+        lanes.append(args.out / "gen2" / kill_name)
+    events, clocks = merge_streams([p for p in lanes if p.exists()])
+    fleet = build_fleet_report(events, clocks)
+    by_class = fleet["serve"]["by_class"]
+    (args.out / "fleet_report.json").write_text(
+        json.dumps(fleet, indent=2, default=str))
+    attained = {}
+    attain_ok = True
+    for slo, row in sorted(by_class.items()):
+        att = row.get("attainment")
+        attained[slo] = att
+        print(f"[loadgen] SLO {slo}: completed={row['completed']} "
+              f"p50={row['latency_p50']} p99={row['latency_p99']} "
+              f"attainment={att}")
+        if att is not None and att < args.attain:
+            attain_ok = False
+    if not by_class:
+        attain_ok = False
+        print("[loadgen] no per-class serve rows in the merged report",
+              file=sys.stderr)
+
+    print(f"[loadgen] audit: {audit}")
+    print(f"[loadgen] replica states: {states}")
+    print(f"[loadgen] shed: first={shed_first} retried-ok={shed_retry_ok} "
+          f"exhausted={shed_exhausted} final={shed_final} "
+          f"retry-success-rate="
+          f"{'n/a' if retry_rate is None else f'{retry_rate:.2f}'}")
+    print(f"[loadgen] merged lanes: {len(clocks)} "
+          f"({', '.join(str(p.name) for p in lanes)})")
+
+    killed = t_kill is None and 0 <= args.kill_frac <= 1
+    ok = (dropped == 0 and mismatched == 0 and audit["balanced"]
+          and audit["outstanding"] == 0 and ok_count > 0
+          and (not killed or audit["replica_deaths"] >= 1)
+          and lock_cycle is None and attain_ok)
+    if ok:
+        print(f"[loadgen] PASS: zero dropped futures over {len(handles)} "
+              f"admitted arrivals ({ok_count} ok bit-matched, "
+              f"{typed_errors} typed errors, {audit['retries']} retries, "
+              f"{audit['replica_deaths']} replica deaths), per-class "
+              f"attainment >= {args.attain} from merged telemetry")
+        return 0
+    print(f"[loadgen] FAIL: dropped={dropped} mismatched={mismatched} "
+          f"attain_ok={attain_ok} lock_cycle={'yes' if lock_cycle else 'no'}"
+          f" audit={audit}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
