@@ -108,6 +108,26 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
          limit=0.05, window_s=120.0, min_count=1, cooldown_s=600.0,
          describe="HBM headroom under 5% of the device limit — the "
                   "next allocation spike OOMs"),
+    # min_count=1: graftscale stamps the CURRENT reversal count on every
+    # decision record and control ticks are sparse, so the rule may fire
+    # from the very first over-budget sample instead of waiting out
+    # three; during a real thrash every record carries the elevated
+    # count, so the windowed mean crosses within a tick or two even
+    # when calm holds preceded it
+    Rule(name="autoscale_flapping", kind="threshold",
+         select_kind="autoscale", select_names=("decision",),
+         field="flaps", op=">", limit=2.0, window_s=60.0, min_count=1,
+         cooldown_s=120.0,
+         describe="the autoscaler is reversing direction faster than "
+                  "the flap budget — hysteresis is mis-tuned for this "
+                  "load shape"),
+    Rule(name="saturated_at_max", kind="threshold",
+         select_kind="autoscale", select_names=("decision",),
+         field="saturated", op=">", limit=0.5, window_s=60.0,
+         min_count=3, cooldown_s=120.0,
+         describe="the fleet is pinned at max_replicas and still "
+                  "overloaded — the brownout ladder is the only "
+                  "headroom left"),
 )
 
 

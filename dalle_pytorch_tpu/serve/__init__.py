@@ -25,9 +25,12 @@ taxonomy, deadline + bounded retry + jittered backoff, ``rpc_send`` /
 ``ReplicaServer`` with a router-side ``RemoteReplica`` that presents
 the exact ``Replica`` surface — the router needs no remote-aware code.
 """
+from .autoscale import (AutoScaler, Decision, DegradeLevel, ScalePolicy,
+                        Signals)
 from .engine import ArenaGeometry, SlotArena
 from .prefix import RadixPrefixCache
-from .remote import RemoteReplica, ReplicaServer, spawn_replica
+from .remote import (RemoteReplica, ReplicaServer, SpawnFailed,
+                     spawn_replica)
 from .replica import (DEAD, DRAINING, JOINING, SERVING, Replica,
                       ReplicaDown)
 from .router import (FleetRouter, NoHealthyReplica, RequestFailed,
@@ -48,5 +51,6 @@ __all__ = [
     "RetriesExhausted", "RequestFailed", "NoHealthyReplica",
     "WireClient", "WireServer", "WireError", "WireTimeout",
     "WireUnavailable", "WireReset", "WireProtocolError", "WireRemoteError",
-    "RemoteReplica", "ReplicaServer", "spawn_replica",
+    "RemoteReplica", "ReplicaServer", "spawn_replica", "SpawnFailed",
+    "AutoScaler", "Decision", "DegradeLevel", "ScalePolicy", "Signals",
 ]

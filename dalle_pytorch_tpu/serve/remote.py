@@ -67,6 +67,27 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 _EMPTY_BACKLOG = {"queued": {slo: 0 for slo in SLO_CLASSES},
                   "queued_total": 0, "running": 0}
 
+# scale_signals before first contact: unknown headroom, zero ledger —
+# the autoscaler treats an all-default row as "no information yet"
+_EMPTY_SIGNALS = {"queued": {slo: 0 for slo in SLO_CLASSES}, "running": 0,
+                  "num_slots": 0, "headroom_bytes": None,
+                  "predicted_bytes_per_token": 0, "ledger_fingerprint": "",
+                  "spec": False, "spec_capable": False}
+
+
+class SpawnFailed(RuntimeError):
+    """:func:`spawn_replica`'s ready-file handshake failed: the child
+    exited before announcing readiness (``rc`` set) or never wrote the
+    ready file inside the timeout (``rc`` None).  Either way the child
+    has been killed AND reaped before this raises — a failed spawn never
+    leaks an orphan process.  graftscale's spawn budget counts these."""
+
+    def __init__(self, msg: str, *, name: str = "",
+                 rc: Optional[int] = None):
+        super().__init__(msg)
+        self.name = name
+        self.rc = rc
+
 # remote exception-name -> local type: how a collected error re-raises
 # on the caller's side of the wire.  Transient types keep their transient
 # meaning (the router retries them); anything unknown is terminal.
@@ -116,6 +137,7 @@ class ReplicaServer:
             "drain": self._h_drain,
             "stop": self._h_stop,
             "ping": self._h_ping,
+            "configure": self._h_configure,
         }, host=host, port=port)
         self.port = self._wire.port
 
@@ -169,7 +191,10 @@ class ReplicaServer:
         return {"state": r.state, "beat_age_s": round(r.beat_age(), 4),
                 "ticks": r.ticks, "work_ticks": r.work_ticks,
                 "busy": bool(r.server.busy),
-                "backlog": r.server.backlog()}
+                "backlog": r.server.backlog(),
+                # graftscale's observation row rides every collect, so
+                # the client-side cache is at most one pump tick stale
+                "signals": r.server.scale_signals()}
 
     def _h_collect(self, params: dict) -> dict:
         with self._lock:
@@ -203,6 +228,16 @@ class ReplicaServer:
         return {"ok": True, "pid": os.getpid(),
                 "replica": self.replica.name}
 
+    def _h_configure(self, params: dict) -> dict:
+        """Runtime knobs the autoscaler turns fleet-wide (brownout rung
+        1: spec decode off/on).  Returns the state actually in force —
+        a spec-incapable plan answers ``spec: False`` to an enable."""
+        out: dict = {"ok": True}
+        if "spec" in params:
+            out["spec"] = bool(
+                self.replica.server.set_spec(bool(params["spec"])))
+        return out
+
 
 # --- client half ------------------------------------------------------------
 
@@ -233,6 +268,12 @@ class _RemoteServerFacade:
 
     def backlog(self) -> dict:
         return self._r._cached_backlog()
+
+    def scale_signals(self) -> dict:
+        return self._r._cached_signals()
+
+    def set_spec(self, enabled: bool) -> bool:
+        return self._r._configure_spec(enabled)
 
     @property
     def busy(self) -> bool:
@@ -276,6 +317,7 @@ class RemoteReplica:
         self._to_ack: set = set()
         self._remote: dict = {"state": JOINING, "beat_age_s": 0.0,
                               "busy": False, "backlog": dict(_EMPTY_BACKLOG),
+                              "signals": dict(_EMPTY_SIGNALS),
                               "ticks": 0, "work_ticks": 0}
         self._state_hint: Optional[str] = None  # DRAINING/DEAD overlay
         self._protocol_errors = 0
@@ -440,6 +482,8 @@ class RemoteReplica:
                     self._remote[k] = hb[k]
             if "backlog" in hb:
                 self._remote["backlog"] = hb["backlog"]
+            if "signals" in hb:
+                self._remote["signals"] = hb["signals"]
 
     def _cached_backlog(self) -> dict:
         with self._lock:
@@ -447,6 +491,29 @@ class RemoteReplica:
             return {"queued": dict(b["queued"]),
                     "queued_total": b["queued_total"],
                     "running": b["running"]}
+
+    def _cached_signals(self) -> dict:
+        with self._lock:
+            s = dict(self._remote["signals"])
+        s["queued"] = dict(s.get("queued") or {})
+        return s
+
+    def _configure_spec(self, enabled: bool) -> bool:
+        """Brownout rung 1 over the wire.  A transport failure leaves
+        the remote state unchanged and reports the cached value — the
+        autoscaler re-applies the ladder on every transition, so a
+        missed toggle converges on the next apply."""
+        try:
+            resp = self._probe.call("configure",
+                                    {"spec": bool(enabled)},
+                                    deadline_s=self.call_timeout_s)
+        except wire.WireError as e:
+            telemetry.emit("remote", "configure_rpc_failed",
+                           replica=self.name, error=repr(e))
+            return bool(self._cached_signals().get("spec"))
+        with self._lock:
+            self._remote["signals"]["spec"] = bool(resp.get("spec"))
+        return bool(resp.get("spec"))
 
     def _busy(self) -> bool:
         with self._lock:
@@ -641,12 +708,21 @@ def _wait_ready(ready: Path, proc: subprocess.Popen, name: str,
                 pass  # ready file mid-write despite atomic rename: next tick
         rc = proc.poll()
         if rc is not None:
-            raise RuntimeError(
-                f"remote replica {name} exited rc={rc} before ready")
+            raise SpawnFailed(
+                f"remote replica {name} exited rc={rc} before ready",
+                name=name, rc=rc)
         if time.monotonic() > deadline:
+            # kill AND reap: a spawn that never reached the handshake
+            # must not leave an orphan child behind (it would survive
+            # this process and hold its telemetry dir / ports forever)
             proc.kill()
-            raise RuntimeError(
-                f"remote replica {name} not ready after {timeout_s}s")
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                pass  # unreapable (wedged in the kernel): raise anyway
+            raise SpawnFailed(
+                f"remote replica {name} not ready after {timeout_s}s "
+                f"(child killed and reaped)", name=name, rc=None)
         pace.wait(0.05)
 
 

@@ -189,6 +189,10 @@ class FleetRouter:
         self.monitor_interval_s = float(monitor_interval_s)
 
         self._lock = locks.TracedLock("router")
+        # admission shed factors, overridable fleet-wide at runtime (the
+        # graftscale brownout ladder's actuation surface — §22); explicit
+        # shed_bounds still win when set
+        self._shed_factors: Dict[str, float] = dict(_SHED_FACTORS)
         self._replicas: Dict[str, Replica] = {}
         # DRAINING predecessors superseded by a same-name join: out of
         # the by-name table (the ring can never double-count the name)
@@ -259,6 +263,12 @@ class FleetRouter:
     def replica(self, name: str) -> Replica:
         with self._lock:
             return self._replicas[name]
+
+    def replicas(self) -> List[Replica]:
+        """Snapshot of the registered membership (retired same-name
+        predecessors excluded) — the autoscaler's observation surface."""
+        with self._lock:
+            return list(self._replicas.values())
 
     def _serving(self) -> List[Replica]:
         with self._lock:
@@ -385,8 +395,35 @@ class FleetRouter:
         bound = (self.shed_bounds or {}).get(slo)
         if bound is None:
             slots = sum(r.num_slots for r in reps)
-            bound = max(1, int(_SHED_FACTORS[slo] * slots))
+            with self._lock:
+                factor = self._shed_factors.get(slo, _SHED_FACTORS[slo])
+            # factor 0 is the brownout ladder's full-shed rung: bound 0
+            # makes depth >= bound ALWAYS true — every admission in this
+            # class sheds typed and fast instead of queuing to time out
+            bound = max(1, int(factor * slots)) if factor > 0.0 else 0
         return bound, depth
+
+    def set_shed_factors(self, factors: Optional[Dict[str, float]] = None
+                         ) -> None:
+        """Override the per-class admission shed factors fleet-wide —
+        the brownout ladder's reversible actuation surface.  Keys absent
+        from ``factors`` fall back to the defaults; ``None`` restores
+        them entirely; a factor of 0 sheds EVERYTHING in that class.
+        Explicit constructor ``shed_bounds`` still take precedence."""
+        merged = dict(_SHED_FACTORS)
+        merged.update(factors or {})
+        with self._lock:
+            changed = merged != self._shed_factors
+            self._shed_factors = merged
+        if changed:
+            self._emit("router", "shed_factors",
+                       **{slo: merged[slo] for slo in SLO_CLASSES})
+
+    def shed_factors(self) -> Dict[str, float]:
+        """The effective per-class shed factors (a restarted autoscaler
+        reads the current brownout rung back off these)."""
+        with self._lock:
+            return dict(self._shed_factors)
 
     def _shed_retry_after(self, depth: int, bound: int) -> float:
         """Backlog-drain-rate hint: (excess depth) / (recent resolve
@@ -607,6 +644,7 @@ class FleetRouter:
                 self._drain_done(r)  # retired corpse: drop the accounting
         if now - self._last_probe >= self.probe_every_s:
             self._last_probe = now
+            self.audit()  # refresh the live ledger gauges at probe cadence
             for r in reps:
                 if r.state != SERVING:
                     continue
@@ -697,7 +735,7 @@ class FleetRouter:
             outstanding = len(self._tracked)
             submitted = self._next_rid
             shed_total = sum(self.shed.values())
-            return dict(
+            out = dict(
                 submitted=submitted, resolved_ok=self.resolved_ok,
                 resolved_err=self.resolved_err, shed=shed_total,
                 shed_by_class=dict(self.shed), outstanding=outstanding,
@@ -705,6 +743,30 @@ class FleetRouter:
                 replica_deaths=self.replica_deaths,
                 balanced=(submitted == self.resolved_ok + self.resolved_err
                           + shed_total + outstanding))
+        self._publish_audit_gauges(out)
+        return out
+
+    def _publish_audit_gauges(self, a: dict) -> None:
+        """Mirror the ledger onto /metrics so its balance is visible
+        LIVE (the autoscaler's shed-rate input; ``monitor --fleet``
+        prints the same line from the scrape side).  The family is
+        ``graft_router_audit_*``: ``graft_router_submitted_total`` /
+        ``_shed_total`` already exist as per-slo event COUNTERS, and the
+        registry (correctly) refuses to re-register a name under a
+        different kind — the ledger needs point-in-time gauges."""
+        reg = obs_metrics.active()
+        if reg is None:
+            return
+        for field, value in (("submitted", a["submitted"]),
+                             ("ok", a["resolved_ok"]),
+                             ("err", a["resolved_err"]),
+                             ("shed", a["shed"]),
+                             ("outstanding", a["outstanding"])):
+            reg.gauge(f"graft_router_audit_{field}_total",
+                      f"audit ledger: {field}").set(value)
+        reg.gauge("graft_router_audit_balanced",
+                  "1 iff submitted == ok + err + shed + outstanding"
+                  ).set(int(a["balanced"]))
 
     def stats(self) -> dict:
         """Fleet snapshot: per-replica lifecycle + load, plus the audit

@@ -134,7 +134,11 @@ class GenerationServer:
         # slot's accepted span length m and `done`/token accounting add m
         # instead of 1.  SLO/latency math is untouched (it is per-request
         # wall-clock, not per-tick).
-        self._spec = bool(dalle.cfg.spec_decode)
+        # _spec_capable pins what the model plan compiled; _spec is the
+        # RUNTIME toggle (the graftscale brownout ladder's rung 1 —
+        # set_spec()), never exceeding capability
+        self._spec_capable = bool(dalle.cfg.spec_decode)
+        self._spec = self._spec_capable
         self._spec_committed = 0
         # prefix_cache (a server knob, default OFF): admissions sharing a
         # prompt install copies of ONE batch-1 prefill via the refcounted
@@ -164,6 +168,15 @@ class GenerationServer:
         from ..obs import prof
         self.predicted_bytes_per_token = prof.predicted_serve_bytes_per_token(
             dalle.cfg, num_slots)
+        # the ledger row this arena's capacity math cites: graftscale
+        # decision records carry it so "why did we scale" is answerable
+        # from the stream alone (DESIGN.md §22)
+        self.ledger_fingerprint = prof.row_fingerprint(
+            prof.fingerprint_payload(dalle.cfg, target="serve",
+                                     slots=int(num_slots)))
+        # last serve-steady headroom watermark (None until the first
+        # mem poll lands, or when the backend reports no byte limit)
+        self.last_headroom_bytes: Optional[int] = None
         reg = obs_metrics.active()
         if reg is not None:
             reg.gauge("graft_serve_predicted_bytes_per_token",
@@ -610,6 +623,8 @@ class GenerationServer:
         self._ticks_since_watermark = 0
         rec = self.mem_tracker.snapshot("serve_steady")
         self._emit("mem", "watermark", **rec)
+        if rec.get("headroom_bytes") is not None:
+            self.last_headroom_bytes = int(rec["headroom_bytes"])
         reg = obs_metrics.active()
         if reg is not None and rec.get("headroom_bytes") is not None:
             reg.gauge("graft_hbm_headroom_bytes",
@@ -713,6 +728,43 @@ class GenerationServer:
             queued = {slo: len(self._queues[slo]) for slo in SLO_CLASSES}
         return dict(queued=queued, queued_total=sum(queued.values()),
                     running=len(self._running))
+
+    @property
+    def spec_enabled(self) -> bool:
+        return self._spec
+
+    def set_spec(self, enabled: bool) -> bool:
+        """Toggle self-speculative decode at the tick boundary — the
+        brownout ladder's mildest rung (graftscale).  Effective only
+        when the model plan compiled the spec entry points
+        (``cfg.spec_decode``); returns the state actually in force.
+        Safe mid-stream: spec commits are bit-identical to greedy
+        (graftspec's acceptance rule), so flipping between ticks cannot
+        change any decoded codes — only tokens-per-tick.  The flag is a
+        plain bool store (the driver already reads it unlocked per
+        tick); no lock is needed or taken."""
+        want = bool(enabled) and self._spec_capable
+        changed = want != self._spec
+        self._spec = want
+        if changed:
+            self._emit("serve", "spec_toggle", enabled=want)
+        return want
+
+    def scale_signals(self) -> dict:
+        """One autoscaler observation of THIS server: queue depth per
+        class + running slots (the demand side), the last serve-steady
+        headroom watermark + the ledger's per-slot byte stream and row
+        fingerprint (the capacity side), and the spec-decode state (the
+        brownout ladder's rung-1 readback).  Cheap enough to ride the
+        graftwire heartbeat."""
+        b = self.backlog()
+        return dict(
+            queued=b["queued"], running=b["running"],
+            num_slots=self.num_slots,
+            headroom_bytes=self.last_headroom_bytes,
+            predicted_bytes_per_token=self.predicted_bytes_per_token,
+            ledger_fingerprint=self.ledger_fingerprint,
+            spec=self._spec, spec_capable=self._spec_capable)
 
     def trace_counts(self) -> dict:
         return self.arena.trace_counts()

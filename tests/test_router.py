@@ -545,6 +545,48 @@ def test_wait_serving_unblocks_on_close(small):
         router.close()
 
 
+# --- graftscale actuation surface (brownout shed factors) -------------------
+
+
+def test_brownout_shed_factor_zero_sheds_typed_and_reverses(small):
+    """The brownout ladder's router half: set_shed_factors({cls: 0})
+    sheds EVERY admission in that class immediately and typed while the
+    other class still flows; restoring the defaults re-admits.  (With
+    explicit constructor shed_bounds the factors are inert — loadgen and
+    production construct without bounds.)"""
+    router = make_router(small, 1, shed_bounds=None)
+    try:
+        _, _, _, texts, refs = small
+        router.set_shed_factors({THROUGHPUT: 0.0})
+        h = router.submit(texts[0], slo=THROUGHPUT)
+        assert h.future.done()          # resolved AT submit, never a hang
+        assert isinstance(h.future.exception(), ShedError)
+        h2 = router.submit(texts[1], slo=LATENCY)
+        np.testing.assert_array_equal(h2.result(WAIT_S), refs[1])
+        # reversible: restore defaults, the class admits again
+        router.set_shed_factors(None)
+        assert router.shed_factors()[THROUGHPUT] > 0.0
+        h3 = router.submit(texts[2], slo=THROUGHPUT)
+        np.testing.assert_array_equal(h3.result(WAIT_S), refs[2])
+        audit = router.audit()
+        assert audit["balanced"] and audit["shed"] == 1
+    finally:
+        router.close()
+
+
+def test_explicit_shed_bounds_outrank_factors(small):
+    """Constructor shed_bounds are the operator's word: factor overrides
+    must not shed past them."""
+    router = make_router(small, 1)      # shed_bounds=NO_SHED
+    try:
+        _, _, _, texts, refs = small
+        router.set_shed_factors({LATENCY: 0.0, THROUGHPUT: 0.0})
+        h = router.submit(texts[0], slo=LATENCY)
+        np.testing.assert_array_equal(h.result(WAIT_S), refs[0])
+    finally:
+        router.close()
+
+
 # --- observability surfaces -------------------------------------------------
 
 
@@ -576,6 +618,14 @@ def test_replica_state_metrics_and_monitor_scrape(small, capsys):
         assert "graft_router_submitted_total" in text
         assert 'graft_lock_acquires_total{lock="router"}' in text
         assert 'graft_lock_held_seconds_max{lock="router"}' in text
+        # the audit-ledger gauge family (graftscale's input; audit() was
+        # called above, which publishes it)
+        router.audit()
+        text = reg.render()
+        assert "graft_router_audit_submitted_total 1.0" in text
+        assert "graft_router_audit_ok_total 1.0" in text
+        assert "graft_router_audit_outstanding_total 0.0" in text
+        assert "graft_router_audit_balanced 1.0" in text
 
         # a minimal telemetry lane so the fleet scan has a stream to align
         import sys

@@ -97,6 +97,30 @@ def test_single_request_matches_static_sampler(small):
     np.testing.assert_array_equal(h.result(0), refs[0])
 
 
+def test_scale_signals_surface_and_spec_toggle(small):
+    """graftscale's per-server observation: one cheap dict with the
+    demand side (queues, running), the capacity side (headroom + the
+    ledger's per-slot byte stream and row fingerprint), and the spec
+    rung readback — and set_spec is capability-clamped."""
+    srv = make_server(small, num_slots=2)
+    s = srv.scale_signals()
+    assert s["num_slots"] == 2
+    assert s["queued"] == {LATENCY: 0, THROUGHPUT: 0} and s["running"] == 0
+    assert s["predicted_bytes_per_token"] > 0
+    assert len(s["ledger_fingerprint"]) == 12   # prof.row_fingerprint
+    # this cfg compiles no spec entry points: the brownout toggle is
+    # capability-clamped to off in BOTH directions
+    assert not s["spec_capable"] and not s["spec"]
+    assert srv.set_spec(True) is False
+    assert srv.set_spec(False) is False
+    # demand side tracks the queues
+    for t in small[3][:3]:
+        srv.submit(t)
+    s = srv.scale_signals()
+    assert s["queued"][THROUGHPUT] + s["running"] + s["queued"][LATENCY] == 3
+    srv.run_until_idle(max_ticks=300)
+
+
 def test_mid_flight_admission_is_exact_and_single_trace(small):
     """Requests admitted into an in-flight decode batch — slots at mixed
     depths — still reproduce the static sampler bit-for-bit, and the whole

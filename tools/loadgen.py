@@ -29,10 +29,28 @@ Exit 0 iff ALL of:
 * per-SLO-class attainment, read from the MERGED fleet telemetry
   (router lane + one lane per child process), meets ``--attain``.
 
-Usage (the CI ``loadgen_smoke`` row)::
+``--autoscale`` runs the graftscale surge scenario instead (the CI
+``autoscale_smoke`` row): start from ``--replicas`` (typically 1) with an
+:class:`AutoScaler` over the router, step-multiply arrivals by
+``--surge-mult`` inside the surge window, SIGKILL one of the
+autoscaler's own children mid-scale-up, and gate additionally on: the
+fleet reaching ``--max-replicas``, <= ``--max-flaps`` direction
+reversals, every acting decision citing its signals + ledger
+fingerprint in the merged telemetry, and latency-class attainment back
+over ``--attain`` within ``--recovery-window`` of the surge ending.
+
+Usage (the CI ``loadgen_smoke`` / ``autoscale_smoke`` rows)::
 
     python tools/loadgen.py --replicas 3 --duration 12 --kill-frac 0.35 \
         --restart-frac 0.6 --out loadgen-smoke
+    python tools/loadgen.py --replicas 1 --autoscale --max-replicas 2 \
+        --surge-mult 3 --surge-frac 0.1 --surge-end-frac 0.6 \
+        --duration 60 --kill-frac 0.65 --restart-frac -1 \
+        --out autoscale-smoke
+    # sizing: a spawned child pays the full jax compile warmup (~15s on
+    # a CI core) before it can SERVE, and spawns serialize through the
+    # control loop — the surge must start early and the run must be long
+    # enough for spawn -> serve -> SIGKILL -> recover to fit
     python tools/obs_report.py --merge loadgen-smoke/router \
         loadgen-smoke/r* loadgen-smoke/gen2/*
 """
@@ -70,8 +88,9 @@ from dalle_pytorch_tpu.obs import build_fleet_report  # noqa: E402
 from dalle_pytorch_tpu.obs import merge_streams  # noqa: E402
 from dalle_pytorch_tpu.obs import metrics as obs_metrics  # noqa: E402
 from dalle_pytorch_tpu.obs import telemetry  # noqa: E402
-from dalle_pytorch_tpu.serve import (LATENCY, THROUGHPUT,  # noqa: E402
-                                     FleetRouter, RouterError, ShedError)
+from dalle_pytorch_tpu.serve import (LATENCY, SERVING,  # noqa: E402
+                                     THROUGHPUT, AutoScaler, FleetRouter,
+                                     RouterError, ScalePolicy, ShedError)
 from dalle_pytorch_tpu.serve import remote as serve_remote  # noqa: E402
 from dalle_pytorch_tpu.utils import faults, locks  # noqa: E402
 
@@ -97,14 +116,17 @@ def zipf_weights(n: int, s: float):
 
 def build_trace(*, duration_s: float, rate_mean: float, rate_amp: float,
                 prompts: int, zipf_s: float, latency_frac: float,
-                seed: int):
+                seed: int, surge=None):
     """Deterministic open-loop arrival schedule:
     ``[(t_s, prompt_idx, slo), ...]`` sorted by time.  Thinning sampler
     against the diurnal envelope, Zipf prompt choice, Bernoulli SLO
     class mix — all from one seeded RNG so a seed pins the whole
-    trace."""
+    trace.  ``surge=(start_frac, end_frac, mult)`` multiplies the rate
+    by ``mult`` inside that window — the graftscale step burst; ``None``
+    (the default) leaves the schedule bit-identical to before."""
     rng = random.Random(seed)
-    peak = rate_mean * (1.0 + abs(rate_amp))
+    mult = float(surge[2]) if surge else 1.0
+    peak = rate_mean * (1.0 + abs(rate_amp)) * max(1.0, mult)
     if peak <= 0:
         return []
     cum = list(itertools.accumulate(zipf_weights(prompts, zipf_s)))
@@ -115,8 +137,10 @@ def build_trace(*, duration_s: float, rate_mean: float, rate_amp: float,
         if t >= duration_s:
             return out
         # thinning: accept with prob rate(t)/peak -> inhomogeneous Poisson
-        if rng.random() * peak <= diurnal_rate(
-                t / duration_s, rate_mean, rate_amp):
+        rate = diurnal_rate(t / duration_s, rate_mean, rate_amp)
+        if surge and surge[0] <= t / duration_s < surge[1]:
+            rate *= mult
+        if rng.random() * peak <= rate:
             idx = bisect.bisect_left(cum, rng.random())
             slo = LATENCY if rng.random() < latency_frac else THROUGHPUT
             out.append((t, min(idx, prompts - 1), slo))
@@ -163,6 +187,25 @@ def main(argv=None) -> int:
     parser.add_argument("--prefix-cache", action="store_true", default=True)
     parser.add_argument("--no-prefix-cache", dest="prefix_cache",
                         action="store_false")
+    # --- graftscale surge scenario (the autoscale_smoke CI row) ---
+    parser.add_argument("--autoscale", action="store_true",
+                        help="run an AutoScaler over the router: start "
+                             "from --replicas, grow toward --max-replicas "
+                             "under load, brownout at saturation")
+    parser.add_argument("--max-replicas", type=int, default=3)
+    parser.add_argument("--surge-mult", type=float, default=0.0,
+                        help="step-multiply the arrival rate by this "
+                             "inside [--surge-frac, --surge-end-frac) "
+                             "(<=1 disables the surge)")
+    parser.add_argument("--surge-frac", type=float, default=0.25)
+    parser.add_argument("--surge-end-frac", type=float, default=0.65)
+    parser.add_argument("--max-flaps", type=int, default=2,
+                        help="scale-direction reversals tolerated by the "
+                             "gate (autoscale mode)")
+    parser.add_argument("--recovery-window", type=float, default=None,
+                        help="seconds after the surge ends by which "
+                             "latency-class attainment must be back >= "
+                             "--attain (default: 0.25 x --duration)")
     parser.add_argument("--out", type=Path, default=Path("loadgen-out"))
     parser.add_argument("--timeout", type=float, default=420.0,
                         help="bound on the whole run (spawn + trace + "
@@ -215,10 +258,40 @@ def main(argv=None) -> int:
     print(f"[loadgen] {args.replicas} subprocess replicas serving "
           f"({time.monotonic() - t_spawn:.1f}s to warm)")
 
+    scaler = None
+    if args.autoscale:
+        auto_dir = args.out / "auto"
+        spawn_host = itertools.count(args.replicas + 2)
+
+        def spawn_fn(name):
+            return serve_remote.spawn_replica(
+                name, out_dir=auto_dir, slots=args.slots,
+                host_index=next(spawn_host), slo_targets=slo_targets,
+                prefix_cache=args.prefix_cache, remote_stale_s=5.0,
+                ready_timeout_s=max(60.0, args.timeout / 2))
+
+        scaler = AutoScaler(
+            router, spawn_fn,
+            policy=ScalePolicy(min_replicas=1,
+                               max_replicas=args.max_replicas,
+                               up_cooldown_s=1.0, down_cooldown_s=8.0,
+                               down_after=6, max_step=1,
+                               flap_window_s=max(30.0, args.duration),
+                               max_flaps=args.max_flaps),
+            interval_s=0.3).start()
+        print(f"[loadgen] graftscale armed: {args.replicas} -> "
+              f"{args.max_replicas} replicas max")
+
+    surge = ((args.surge_frac, args.surge_end_frac, args.surge_mult)
+             if args.surge_mult > 1.0 else None)
     trace = build_trace(
         duration_s=args.duration, rate_mean=args.rate_mean,
         rate_amp=args.rate_amp, prompts=args.prompts, zipf_s=args.zipf_s,
-        latency_frac=args.latency_frac, seed=args.seed)
+        latency_frac=args.latency_frac, seed=args.seed, surge=surge)
+    if surge:
+        print(f"[loadgen] surge: x{args.surge_mult:g} arrivals in "
+              f"[{args.surge_frac:g}, {args.surge_end_frac:g}) of the "
+              f"trace")
     print(f"[loadgen] trace: {len(trace)} arrivals over "
           f"{args.duration:.0f}s (peak ~"
           f"{args.rate_mean * (1 + args.rate_amp):.1f}/s)")
@@ -256,17 +329,52 @@ def main(argv=None) -> int:
                 shed_exhausted += 1
         handles.append((h, idx, tries))
 
+    surge_end_t = (args.surge_end_frac * args.duration if surge else None)
+    surge_end_wall = None
+    peak_observed = 0  # fleet serving count witnessed outside decisions
     start = time.monotonic()
     i = 0
     new_remote = None
     while True:
         now_t = time.monotonic() - start
+        if surge_end_t is not None and now_t >= surge_end_t:
+            surge_end_t = None
+            surge_end_wall = time.time()
+            print(f"[loadgen] t={now_t:.2f}s: surge over, recovery "
+                  f"clock running")
         if t_kill is not None and now_t >= t_kill:
-            t_kill = None
-            victim = next(r for r in remotes if r.name == kill_name)
-            victim.proc.kill()
-            print(f"[loadgen] CHAOS t={now_t:.2f}s: SIGKILL {kill_name} "
-                  f"(pid {victim.proc.pid})")
+            if scaler is not None:
+                # kill one of the AUTOSCALER's own children — the
+                # mid-scale-up death the gate is about.  Stays armed
+                # until a spawned replica is actually SERVING: killing a
+                # still-warming JOINING child would only prove the spawn
+                # path, not the serve-then-die migration the gate wants
+                # (and would make the reach-target gate unreachable
+                # inside one run).
+                victims = [r for r in scaler.spawned
+                           if r.proc is not None and r.proc.poll() is None
+                           and r.state == SERVING]
+                if victims:
+                    t_kill = None
+                    victim = victims[0]
+                    # the victim filter just witnessed a spawned child
+                    # SERVING — snapshot the fleet serving count NOW,
+                    # because the SIGKILL below races the scaler's next
+                    # collect tick and no decision record may ever
+                    # observe the peak the fleet provably reached
+                    peak_observed = max(peak_observed, sum(
+                        1 for r in router.stats()["replicas"].values()
+                        if r["state"] == "serving"))
+                    victim.proc.kill()
+                    print(f"[loadgen] CHAOS t={now_t:.2f}s: SIGKILL "
+                          f"{victim.name} mid-scale-up "
+                          f"(pid {victim.proc.pid})")
+            else:
+                t_kill = None
+                victim = next(r for r in remotes if r.name == kill_name)
+                victim.proc.kill()
+                print(f"[loadgen] CHAOS t={now_t:.2f}s: SIGKILL "
+                      f"{kill_name} (pid {victim.proc.pid})")
         if t_restart is not None and now_t >= t_restart:
             t_restart = None
             # same NAME, fresh process + fresh lane dir: the rolling
@@ -334,6 +442,18 @@ def main(argv=None) -> int:
         except Exception:
             dropped += 1
 
+    scale_ups = scale_downs = peak_replicas = flaps_seen = level_peak = 0
+    if scaler is not None:
+        scaler.close()   # stop actuating before the fleet tears down
+        for d in scaler.decisions:
+            if d.action == "scale_up":
+                scale_ups += 1
+            elif d.action == "scale_down":
+                scale_downs += 1
+            peak_replicas = max(peak_replicas, d.signals.serving)
+            flaps_seen = max(flaps_seen, d.flaps)
+            level_peak = max(level_peak, int(d.level))
+        peak_replicas = max(peak_replicas, peak_observed)
     audit = router.audit()
     states = {n: r["state"] for n, r in router.stats()["replicas"].items()}
     retry_rate = (shed_retry_ok / shed_first) if shed_first else None
@@ -358,6 +478,8 @@ def main(argv=None) -> int:
     lanes += [args.out / f"r{j}" for j in range(args.replicas)]
     if new_remote is not None:
         lanes.append(args.out / "gen2" / kill_name)
+    if scaler is not None:
+        lanes += [args.out / "auto" / r.name for r in scaler.spawned]
     events, clocks = merge_streams([p for p in lanes if p.exists()])
     fleet = build_fleet_report(events, clocks)
     by_class = fleet["serve"]["by_class"]
@@ -378,6 +500,53 @@ def main(argv=None) -> int:
         print("[loadgen] no per-class serve rows in the merged report",
               file=sys.stderr)
 
+    # --- graftscale gates (autoscale mode only) ---
+    auto_ok = True
+    recovery_ok = True
+    if scaler is not None:
+        deci = [r for r in events if r.get("kind") == "autoscale"
+                and r.get("name") == "decision"]
+        acts = [r for r in deci if r.get("action") != "hold"]
+        # every ACTING decision must cite its signals and the ledger row
+        uncited = [r for r in acts
+                   if not r.get("ledger_fingerprint")
+                   or r.get("queued_latency") is None]
+        reached = peak_replicas >= args.max_replicas
+        auto_ok = (scale_ups >= 1 and reached and bool(acts)
+                   and not uncited and flaps_seen <= args.max_flaps)
+        print(f"[loadgen] autoscale: {len(deci)} decisions "
+              f"({scale_ups} up, {scale_downs} down, "
+              f"{len(acts) - len(uncited)}/{len(acts)} acting decisions "
+              f"ledger-cited), peak {peak_replicas}/{args.max_replicas} "
+              f"serving, flaps {flaps_seen} (<= {args.max_flaps}), "
+              f"brownout peak level {level_peak}, "
+              f"{scaler.spawn_failures} spawn failures")
+        if not auto_ok:
+            print(f"[loadgen] autoscale gate FAILED: scale_ups="
+                  f"{scale_ups} reached={reached} uncited={len(uncited)} "
+                  f"flaps={flaps_seen}", file=sys.stderr)
+        if surge_end_wall is not None:
+            window = (args.recovery_window if args.recovery_window
+                      is not None else 0.25 * args.duration)
+            cut = surge_end_wall + window
+            lat = [r for r in events if r.get("kind") == "serve"
+                   and r.get("name") == "retire"
+                   and r.get("slo") == LATENCY
+                   and r.get("slo_ok") is not None and r.get("t")]
+            tail = ([r for r in lat if float(r["t"]) >= cut]
+                    or [r for r in lat if float(r["t"]) >= surge_end_wall])
+            if tail:
+                rec_att = sum(bool(r["slo_ok"]) for r in tail) / len(tail)
+                recovery_ok = rec_att >= args.attain
+                print(f"[loadgen] recovery: latency attainment "
+                      f"{rec_att:.3f} over {len(tail)} retirements after "
+                      f"surge end (+{window:.1f}s window), floor "
+                      f"{args.attain}")
+            else:
+                recovery_ok = False
+                print("[loadgen] recovery: NO latency retirements after "
+                      "the surge ended", file=sys.stderr)
+
     print(f"[loadgen] audit: {audit}")
     print(f"[loadgen] replica states: {states}")
     print(f"[loadgen] shed: first={shed_first} retried-ok={shed_retry_ok} "
@@ -391,7 +560,8 @@ def main(argv=None) -> int:
     ok = (dropped == 0 and mismatched == 0 and audit["balanced"]
           and audit["outstanding"] == 0 and ok_count > 0
           and (not killed or audit["replica_deaths"] >= 1)
-          and lock_cycle is None and attain_ok)
+          and lock_cycle is None and attain_ok and auto_ok
+          and recovery_ok)
     if ok:
         print(f"[loadgen] PASS: zero dropped futures over {len(handles)} "
               f"admitted arrivals ({ok_count} ok bit-matched, "
@@ -400,7 +570,9 @@ def main(argv=None) -> int:
               f"attainment >= {args.attain} from merged telemetry")
         return 0
     print(f"[loadgen] FAIL: dropped={dropped} mismatched={mismatched} "
-          f"attain_ok={attain_ok} lock_cycle={'yes' if lock_cycle else 'no'}"
+          f"attain_ok={attain_ok} auto_ok={auto_ok} "
+          f"recovery_ok={recovery_ok} "
+          f"lock_cycle={'yes' if lock_cycle else 'no'}"
           f" audit={audit}", file=sys.stderr)
     return 1
 

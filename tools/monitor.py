@@ -202,14 +202,16 @@ _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 
 
 def _scrape_replica_metrics(url: str, timeout: float = 3.0
-                            ) -> tuple[dict[str, dict], dict[str, dict]]:
+                            ) -> tuple[dict[str, dict], dict[str, dict],
+                                       dict[str, float]]:
     """GET an endpoint's /metrics and fold the per-replica serve series
     into ``{replica: {state, queue: {slo: depth}, occupancy,
     bytes_per_token, hbm_headroom}}`` plus the graftrace witness series
-    into ``{lock: {acquires, contended, wait_s, held_s, held_max_s}}``.
-    Only replica-labeled (serve) / lock-labeled (witness) series
-    participate (a single-server trainer's unlabeled gauges are not a
-    fleet)."""
+    into ``{lock: {acquires, contended, wait_s, held_s, held_max_s}}``
+    plus the router's live audit ledger (``graft_router_audit_*``
+    gauges) into ``{field: value}``.  Only replica-labeled (serve) /
+    lock-labeled (witness) / router-audit series participate (a
+    single-server trainer's unlabeled gauges are not a fleet)."""
     import urllib.request
 
     target = url if "://" in url else f"http://{url}"
@@ -219,6 +221,7 @@ def _scrape_replica_metrics(url: str, timeout: float = 3.0
         text = resp.read().decode("utf-8", "replace")
     out: dict[str, dict] = {}
     locks: dict[str, dict] = {}
+    ledger: dict[str, float] = {}
     lock_fields = {
         "graft_lock_acquires_total": "acquires",
         "graft_lock_contended_total": "contended",
@@ -242,6 +245,10 @@ def _scrape_replica_metrics(url: str, timeout: float = 3.0
         if lk is not None and name in lock_fields:
             locks.setdefault(lk, {})[lock_fields[name]] = v
             continue
+        if name.startswith("graft_router_audit_"):
+            ledger[name[len("graft_router_audit_"):]
+                   .removesuffix("_total")] = v
+            continue
         rep = labels.get("replica")
         if rep is None:
             continue
@@ -256,7 +263,7 @@ def _scrape_replica_metrics(url: str, timeout: float = 3.0
             info["bytes_per_token"] = v
         elif name == "graft_hbm_headroom_bytes":
             info["hbm_headroom"] = v
-    return out, locks
+    return out, locks, ledger
 
 
 def _print_replica_metrics(urls: list[str]) -> int:
@@ -265,12 +272,12 @@ def _print_replica_metrics(urls: list[str]) -> int:
     bad = 0
     for url in urls:
         try:
-            reps, lock_stats = _scrape_replica_metrics(url)
+            reps, lock_stats, ledger = _scrape_replica_metrics(url)
         except OSError as e:
             print(f"metrics {url}: unreachable ({e})", file=sys.stderr)
             bad += 1
             continue
-        if not reps and not lock_stats:
+        if not reps and not lock_stats and not ledger:
             print(f"metrics {url}: no replica-labeled serve series")
             continue
         for name in sorted(reps):
@@ -297,6 +304,16 @@ def _print_replica_metrics(urls: list[str]) -> int:
                     f"hbm headroom {info['hbm_headroom'] / 2**20:.0f} MiB")
             flag = "  << DOWN" if state == "dead" else ""
             print(f"replica {name} [{url}]: {' '.join(bits)}{flag}")
+        if ledger:
+            # the router's live audit ledger (graftscale's input signals):
+            # submitted == ok + err + shed + outstanding, and "balanced"
+            # says the invariant held at scrape time
+            fields = ["submitted", "ok", "err", "shed", "outstanding"]
+            bits = [f"{f}={int(ledger[f])}" for f in fields if f in ledger]
+            bal = ledger.get("balanced")
+            if bal is not None:
+                bits.append("balanced" if bal >= 1.0 else "UNBALANCED")
+            print(f"router ledger [{url}]: {' '.join(bits)}")
         if lock_stats:
             # graftrace witness rollup: the top held-time locks tell you
             # WHERE serialization lives; contended acquires tell you who

@@ -33,6 +33,7 @@ DEFAULT_TARGETS = (
     "dalle_pytorch_tpu/serve/scheduler.py",
     "dalle_pytorch_tpu/serve/replica.py",
     "dalle_pytorch_tpu/serve/router.py",
+    "dalle_pytorch_tpu/serve/autoscale.py",
     "dalle_pytorch_tpu/serve/prefix.py",
     "dalle_pytorch_tpu/utils/ckpt_manager.py",
     "dalle_pytorch_tpu/obs/metrics.py",
