@@ -858,6 +858,51 @@ def rule_thr002(ctx: FileCtx) -> Iterator[RuleHit]:
                 break
 
 
+_PLAN_SHARDING_CTORS = frozenset(("Mesh", "NamedSharding", "PartitionSpec"))
+
+
+def rule_plan001(ctx: FileCtx) -> Iterator[RuleHit]:
+    """Hand-constructed ``Mesh``/``NamedSharding``/``PartitionSpec``
+    outside ``parallel/`` bypasses the ParallelPlan contract: the sharding
+    never flows through PARTITION_RULES, so graftplan's P1-P4 analyses
+    (rule coverage, axis divisibility, HBM fit, collective placement —
+    lint/plans.py) cannot see it, and spec strings drift from the plan the
+    run declared.  Go through the plan registry and ``Partitioner``
+    (``plan.partitioner().param_specs/shard_batch``) instead, or pragma
+    with why this sharding is genuinely outside the plan's rule table.
+    The ``parallel/`` package itself and fixture files are exempt: they
+    are where the contract is implemented and tested."""
+    msg = ("hand-constructed {}() bypasses the ParallelPlan rule table — "
+           "graftplan's static analyses can't see this sharding; build it "
+           "through parallel.plan/Partitioner or pragma with why it lives "
+           "outside the plan contract")
+    norm = ctx.path.replace("\\", "/")
+    if "/parallel/" in norm or norm.startswith("parallel/") \
+            or norm.endswith("_fixtures.py"):
+        return
+    # local aliases of the ctors: `from jax.sharding import
+    # PartitionSpec as P` must still match — walk the WHOLE tree, since
+    # this repo imports jax lazily inside functions (ENV001 discipline)
+    aliases = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module in ("jax.sharding", "jax.experimental.pjit"):
+            for a in node.names:
+                if a.name in _PLAN_SHARDING_CTORS:
+                    aliases[a.asname or a.name] = a.name
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        name = chain.split(".")[-1]
+        if name in _PLAN_SHARDING_CTORS and (
+                chain == f"jax.sharding.{name}"
+                or chain == f"sharding.{name}"):
+            yield node, msg.format(name)
+        elif chain in aliases:
+            yield node, msg.format(aliases[chain])
+
+
 RULES = {
     "ENV001": rule_env001,
     "SEED001": rule_seed001,
@@ -875,4 +920,5 @@ RULES = {
     "THR002": rule_thr002,
     "DON001": rule_don001,
     "DON002": rule_don002,
+    "PLAN001": rule_plan001,
 }

@@ -270,6 +270,13 @@ PLAN_REGISTRY = {
     # the dim-512 scale rung: ZeRO param sharding is what makes ~345M fit
     # a 16 GiB chip at all (presets.cub512_config is the geometry half)
     "cub-512": ParallelPlan("cub-512", fsdp=4),
+    # the dim-1024 MFU rung (~1.3B, presets.cub1024_config): the fsdp x tp
+    # hybrid — all 8 ways go to state sharding, none to dp, and splitting
+    # features over tp on top of fsdp keeps the per-device all-gather
+    # working set below pure fsdp-8's (tools/plan_search.py's chip-free
+    # sweep scores this cell against the alternatives, dcn variants
+    # included, and PLAN_LEDGER.json pins the winner per topology)
+    "cub-1024": ParallelPlan("cub-1024", fsdp=4, tp=2),
 }
 
 

@@ -3,7 +3,7 @@
 One place naming the (config geometry, ParallelPlan) pairs a run can ask
 for by name, so ``train_dalle.py``'s hard-coded CUB block is one preset
 of many and the analysis suite can gate rungs that do not fit a single
-chip.  Three rungs today:
+chip.  Four rungs today:
 
 ==========  ======  ========  =======================================
 preset      params  geometry  role
@@ -13,10 +13,17 @@ cub         ~15M    dim-256   the production CUB-200 run (PR 1..14)
 cub-512     ~345M   dim-512   first scale rung where HBM genuinely
                               binds: S4 says ~13.2 GiB/device under
                               fsdp-4 vs v5e-4's 14.4 GiB budget
+cub-1024    ~1.3B   dim-1024  the MFU rung (ROADMAP direction 1):
+                              4096 image tokens (fmap-64), the first
+                              geometry where arithmetic intensity
+                              crosses the v5e ridge and fsdp-x-tp /
+                              dcn-hybrid plan choices diverge —
+                              graftplan's autotuner sweep lives here
 ==========  ======  ========  =======================================
 
-``cub-512`` is ALSO a :data:`~dalle_pytorch_tpu.parallel.plan.
-PLAN_REGISTRY` entry (fsdp-4 — the ZeRO sharding that makes 345M fit at
+``cub-512`` and ``cub-1024`` are ALSO :data:`~dalle_pytorch_tpu.parallel.
+plan.PLAN_REGISTRY` entries (fsdp-4, and the fsdp-4 x tp-2 hybrid
+respectively — the ZeRO/tensor shardings that make those counts fit at
 all): registry name and config preset resolve together via
 :data:`SCALE_PRESETS`.  Scale-preset registry entries are excluded from
 ``tools/spmd_check.py``'s default per-push matrix (their S4 compile at
@@ -31,6 +38,8 @@ platform env BEFORE anything touches jax, and it imports this module.
 """
 from __future__ import annotations
 
+import functools
+
 #: Param-count acceptance bands (min, max) per preset — contract_check's
 #: cheap chip-free gate that a geometry edit doesn't silently change the
 #: rung's scale class.
@@ -38,6 +47,7 @@ PARAM_BANDS = {
     "tiny": (0.01e6, 1e6),
     "cub": (10e6, 25e6),
     "cub-512": (300e6, 400e6),
+    "cub-1024": (1.15e9, 1.45e9),
 }
 
 
@@ -80,11 +90,45 @@ def cub512_config(**overrides):
     return DALLEConfig(**base)
 
 
+def cub1024_config(**overrides):
+    """The dim-1024 MFU rung (~1.3B params): captions unchanged but the
+    code grid doubled to 64x64 (4096 image tokens — a finer VAE stride at
+    the same 256px crops), dim-1024 x 76 layers x 16 heads.  This is the
+    first geometry where the roofline's arithmetic intensity crosses the
+    v5e ridge (~240 FLOP/byte) and plan choice genuinely matters: pure
+    fsdp no longer fits the S4 budget at batch 8, the fsdp-4 x tp-2
+    hybrid does, and on multi-slice topologies the dcn placement of the
+    grad all-reduce decides whether the step is ICI- or DCN-bound
+    (tools/plan_search.py sweeps exactly those choices).
+
+    ``use_remat`` is ON at this rung: without per-block rematerialization
+    the backward pass keeps every block's activations live and the
+    compiled S4 estimate shows ~216 GiB/device of XLA temporaries at
+    batch 8 — no chip holds that.  Remat trades the recompute (the
+    roofline is byte-bound here anyway) for per-layer-bounded liveness:
+    the jaxpr walker's peak drops 2541 -> 86 GiB global (~10.7
+    GiB/device under the hybrid plan).  Note the *opt0 compiled*
+    estimate still reads ~132 GiB/device — opt0 buffer assignment does
+    not reuse buffers across remat regions, so it sums all 76 blocks —
+    which is why spmd_check.S4_PRESET_EXPECT declares this rung "over"
+    and gates the compiled proof as a drift sentinel rather than a fit
+    proof (the walker + P3 own the fit verdict here)."""
+    from dalle_pytorch_tpu import DALLEConfig
+
+    base = dict(dim=1024, depth=76, heads=16, dim_head=64,
+                num_text_tokens=7800, text_seq_len=80,
+                num_image_tokens=1024, image_size=256, image_fmap_size=64,
+                use_remat=True)
+    base.update(overrides)
+    return DALLEConfig(**base)
+
+
 #: Every named config geometry (CLI ``--preset`` surface).
 CONFIG_PRESETS = {
     "tiny": tiny_config,
     "cub": cub_config,
     "cub-512": cub512_config,
+    "cub-1024": cub1024_config,
 }
 
 #: The scale rungs that are ALSO plan-registry entries: registry name ->
@@ -92,6 +136,7 @@ CONFIG_PRESETS = {
 #: default per-push matrix and proves them under ``--presets``.
 SCALE_PRESETS = {
     "cub-512": cub512_config,
+    "cub-1024": cub1024_config,
 }
 
 
@@ -103,9 +148,12 @@ def preset_config(name: str, **overrides):
     return CONFIG_PRESETS[name](**overrides)
 
 
+@functools.lru_cache(maxsize=None)
 def preset_param_count(name: str) -> int:
     """Chip-free param count of a preset's DALLE (eval_shape — nothing
-    executes)."""
+    executes).  Pure per name (presets take no free parameters), so the
+    eval_shape trace — seconds at dim-1024 — runs once per process even
+    when several gates band-check the same rung."""
     import jax
     import jax.numpy as jnp
 

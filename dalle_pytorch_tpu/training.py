@@ -224,10 +224,10 @@ def make_dalle_sp_train_step(dalle, tx, mesh, dp_axis: str = "dp",
                 return jax.lax.pmean(loss, dp_axis), ok
             return jax.lax.pmean(loss, dp_axis)
 
-        out_specs = (P(), P()) if health else P()
+        out_specs = (P(), P()) if health else P()  # graftlint: disable=PLAN001 (shard_map arg placement for the sp step — batch over dp, params replicated; not a param-tree sharding, so the rule table does not apply)
         return shard_map(
             local, mesh=mesh,
-            in_specs=(P(), P(dp_axis), P(dp_axis), P()),
+            in_specs=(P(), P(dp_axis), P(dp_axis), P()),  # graftlint: disable=PLAN001 (same: per-arg shard_map specs, not PARTITION_RULES territory)
             out_specs=out_specs, check_vma=False)(params, text, codes, rng)
 
     def train_step(params, opt_state, _vae_params, text, codes, rng,

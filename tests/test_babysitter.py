@@ -25,7 +25,7 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-N_STAGES = 19  # keep in sync with STAGES in tools/chip_babysitter.sh
+N_STAGES = 20  # keep in sync with STAGES in tools/chip_babysitter.sh
 
 
 def script_qv() -> int:

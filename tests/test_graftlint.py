@@ -874,6 +874,60 @@ def test_don002_pragma():
     assert lint(src, select=("DON002",)) == []
 
 
+# --- PLAN001 --------------------------------------------------------------
+
+
+def test_plan001_hand_constructed_sharding_flagged():
+    """Mesh/NamedSharding/PartitionSpec construction (dotted, bare, or
+    aliased — including the lazy in-function imports this repo uses)
+    outside parallel/ bypasses the ParallelPlan rule table."""
+    src = """
+    import jax
+
+    def place(params, devices):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(devices, ("x",))
+        sh = NamedSharding(mesh, P("x"))
+        spec = jax.sharding.PartitionSpec(None)
+        return sh, spec
+    """
+    found = lint(src, select=("PLAN001",),
+                 path="dalle_pytorch_tpu/serve/replica.py")
+    assert rules_of(found) == ["PLAN001"] * 4
+
+
+def test_plan001_partitioner_path_and_exempt_surfaces_clean():
+    """Plan-mediated sharding (the Partitioner API) never constructs the
+    jax.sharding types by hand, and the two exempt surfaces — the
+    parallel/ package that implements the contract and analyzer fixture
+    files — stay clean."""
+    src = """
+    from dalle_pytorch_tpu.parallel.plan import PLAN_REGISTRY
+
+    def place(params):
+        part = PLAN_REGISTRY["fsdp"].partitioner()
+        return part.param_specs(params), part.shard_batch
+    """
+    assert lint(src, select=("PLAN001",),
+                path="dalle_pytorch_tpu/serve/replica.py") == []
+    raw = ("def f(devices):\n"
+           "    from jax.sharding import Mesh\n"
+           "    return Mesh(devices, ('x',))\n")
+    for path in ("dalle_pytorch_tpu/parallel/mesh.py",
+                 "dalle_pytorch_tpu/lint/plans_fixtures.py"):
+        assert lint_source(raw, select=("PLAN001",), path=path) == [], path
+
+
+def test_plan001_pragma_with_reason_suppresses():
+    src = ("def f(devices):\n"
+           "    from jax.sharding import Mesh\n"
+           "    return Mesh(devices, ('_all',))  "
+           "# graftlint: disable=PLAN001 (checkpoint IO is plan-agnostic: "
+           "restore must work under any plan)\n")
+    assert lint_source(src, select=("PLAN001",),
+                       path="dalle_pytorch_tpu/utils/checkpoint.py") == []
+
+
 # --- PRAGMA002: unused suppressions --------------------------------------
 
 
@@ -1096,7 +1150,7 @@ def test_every_rule_has_fixture_coverage():
     without positive-fixture coverage fails here."""
     covered = {"ENV001", "SEED001", "BACKEND001", "DOT001", "TRACE001",
                "EXC001", "CKPT001", "OBS001", "OBS002", "OBS003", "SRV001",
-               "THR001", "THR002", "DON001", "DON002", "MEM001"}
+               "THR001", "THR002", "DON001", "DON002", "MEM001", "PLAN001"}
     assert covered == set(RULES)
 
 

@@ -29,9 +29,9 @@ cd "$(dirname "$0")/.."
 # re-armed queue whose stage COMMANDS changed can never be skipped by a
 # stale marker from an older queue definition — bump QV whenever any
 # stage's command line changes.
-QV=12
+QV=13
 
-STAGES="gen_bf16_ab gen_int8_ab gen_spec_ab serve_prefix_ab gen_fused_ab ab_cand bench xprof_capture gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap bench_serve"
+STAGES="spmd_1024 gen_bf16_ab gen_int8_ab gen_spec_ab serve_prefix_ab gen_fused_ab ab_cand bench xprof_capture gen_ab gen64_ab bench64 ab_core ab_pallas loss_tpu ab_ptiles ab_batch ab_knobs ab_fmap bench_serve"
 
 # Overridable knobs so tests/test_babysitter.py can drive the REAL script
 # (fake python on PATH, private marker dir, second-scale sleeps) without
@@ -283,6 +283,21 @@ if [ -n "${BABYSIT_TRAIN_CMD:-}" ]; then
 fi
 
 # -- the queue, highest evidence value first -------------------------------
+# compiled-S4 proof at the cub-1024 rung (ISSUE 20): AOT-lower the full
+# registry train step at dim-1024 on the virtual CPU mesh and gate the
+# compiler's own per-device HBM estimate through the rung's declared
+# verdict (spmd_check.S4_PRESET_EXPECT: cub-1024 is "over" — opt0 buffer
+# assignment is reuse-free across remat blocks, so the stage is a drift
+# sentinel on the committed estimate, not a fit proof; P3 + the walker
+# own the fit verdict).  The proof is cached in S4_PROOFS.json keyed by a
+# config+plan fingerprint, so an unchanged rung re-gates in seconds; a
+# geometry/plan drift pays the long recompile HERE (chip-free, retryable)
+# instead of on the pod.  First in the queue because a red scale proof
+# should surface before any chip budget is spent.  Timeout sized for the
+# COLD dim-1024 opt0 compile (tens of minutes on a weak core), not the
+# cached re-gate.
+run_stage spmd_1024 3600 env JAX_PLATFORMS=cpu python tools/spmd_check.py \
+  --preset cub-1024 --chip v5e-4
 # bf16 KV cache at eval dtype (f32 activations) vs the f32-cache control:
 # the decode loop is measured HBM-bound on cache reads (gen_ab 2.16x), so
 # this is the round's headline decode A/B.  Two cold decode-scan compiles

@@ -139,18 +139,21 @@ def _dalle_mem_row(plan: str, make_cfg) -> dict:
     return _wrap(prof.row_fingerprint(config), target, plan, memrow)
 
 
-def _cub512_mem_row() -> dict:
-    """The scale rung's memory row — the one where headroom genuinely
-    binds.  Walker-only (dim-512 compiles for ~8 minutes; the compiled
-    S4 proof is ``spmd_check --presets``' nightly concern): resident
-    state divided by the fsdp shard factor, activations from the global
-    peak-live walk divided across the mesh — the analytic stand-in the
-    decode row precedent allows, held stable for the drift gate."""
+def _scale_mem_row(plan: str) -> dict:
+    """A scale rung's memory row — the ones where headroom genuinely
+    binds.  Walker-only (dim-512 compiles for ~8 minutes, dim-1024
+    longer; the compiled S4 proof is ``spmd_check --presets``' nightly
+    concern, cached in S4_PROOFS.json): resident state divided by the
+    plan's state-sharding ways (fsdp x tp — both axes cut params and
+    moments; lint/plans.py's per-leaf walk is the exact version, this
+    uniform factor is the committed-row convention), activations from
+    the global peak-live walk divided across the mesh — the analytic
+    stand-in the decode row precedent allows, held stable for the drift
+    gate."""
     from dalle_pytorch_tpu.parallel.plan import PLAN_REGISTRY
-    from dalle_pytorch_tpu.presets import cub512_config
+    from dalle_pytorch_tpu.presets import preset_config
 
-    plan = "cub-512"
-    cfg = cub512_config()
+    cfg = preset_config(plan)
     dalle = DALLE(cfg)
     tx = make_optimizer(1e-3)
     mesh_kwargs = PLAN_REGISTRY[plan].mesh_kwargs()
@@ -175,7 +178,8 @@ def _cub512_mem_row() -> dict:
         opt_bytes=mem.tree_bytes(opt),
         walker_peak_bytes=walk["peak_bytes"],
         resident_bytes=walk["resident_bytes"],
-        devices=devices, shard_factor=PLAN_REGISTRY[plan].fsdp)
+        devices=devices,
+        shard_factor=PLAN_REGISTRY[plan].fsdp * PLAN_REGISTRY[plan].tp)
     target = f"dalle/{plan}"
     config = graftprof._cfg_payload(cfg, target=target, plan=plan,
                                     batch=TRAIN_BATCH)
@@ -404,7 +408,10 @@ def sweep(quick: bool = False, targets_filter=None) -> dict:
         builders.append((f"dalle/{plan}",
                          lambda p=plan: _dalle_mem_row(p, make_cfg)))
     if not quick:
-        builders.append(("dalle/cub-512", _cub512_mem_row))
+        builders.append(("dalle/cub-512",
+                         lambda: _scale_mem_row("cub-512")))
+        builders.append(("dalle/cub-1024",
+                         lambda: _scale_mem_row("cub-1024")))
     builders.append(("vae", lambda: _vae_mem_row(quick)))
     builders.append(("clip", lambda: _clip_mem_row(quick)))
     builders.append(("decode", lambda: _decode_mem_row(make_cfg)))

@@ -173,16 +173,16 @@ def _dalle_plan_row(plan: str, make_cfg) -> dict:
                               compiled=compiled)
 
 
-def _cub512_row() -> dict:
-    """The dim-512 scale rung (presets.cub512_config under its fsdp-4
+def _scale_row(plan: str) -> dict:
+    """A scale rung's row (presets.SCALE_PRESETS geometry under its
     registry plan): walker-only — no opt0 compile (dim-512 compiles for
-    ~8 minutes; the full S4 proof is ``spmd_check --presets``' nightly
-    concern), the same carve-out as the decode row.  The memory twin in
-    ``tools/graftmem.py`` gives this rung its binding headroom verdict."""
-    from dalle_pytorch_tpu.presets import cub512_config
+    ~8 minutes, dim-1024 longer; the full S4 proof is ``spmd_check
+    --presets``' nightly concern, cached in S4_PROOFS.json), the same
+    carve-out as the decode row.  The memory twin in ``tools/graftmem.py``
+    gives each rung its binding headroom verdict."""
+    from dalle_pytorch_tpu.presets import preset_config
 
-    plan = "cub-512"
-    cfg = cub512_config()
+    cfg = preset_config(plan)
     dalle = DALLE(cfg)
     tx = make_optimizer(1e-3)
     text = _sds((TRAIN_BATCH, cfg.text_seq_len), jnp.int32)
@@ -423,9 +423,11 @@ def sweep(quick: bool = False, targets_filter=None) -> dict:
         builders.append((f"dalle/{plan}",
                          lambda p=plan: _dalle_plan_row(p, make_cfg)))
     if not quick:
-        # the scale rung rides the full sweep only (its point is the
-        # real dim-512 geometry; a quick twin would fingerprint apart)
-        builders.append(("dalle/cub-512", _cub512_row))
+        # the scale rungs ride the full sweep only (their point is the
+        # real dim-512/dim-1024 geometry; quick twins would fingerprint
+        # apart)
+        builders.append(("dalle/cub-512", lambda: _scale_row("cub-512")))
+        builders.append(("dalle/cub-1024", lambda: _scale_row("cub-1024")))
     builders.append(("vae", lambda: _vae_row(quick)))
     builders.append(("clip", lambda: _clip_row(quick)))
     builders.append(("decode", lambda: _decode_row(make_cfg)))

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""graftplan CLI — static ParallelPlan contract sweep (the graftrace of
+sharding; analyses live in dalle_pytorch_tpu/lint/plans.py).
+
+Usage:
+    python tools/plan_check.py                     # sweep cub/cub-512/cub-1024
+    python tools/plan_check.py --presets tiny,cub  # sweep specific presets
+    python tools/plan_check.py --select P1,P2      # subset of analyses
+    python tools/plan_check.py --json out.json     # machine-readable findings
+    python tools/plan_check.py --selftest          # prove P1-P4 catch fixtures
+
+Exit codes: 0 clean, 1 findings, 2 usage error.  Chip-free: eval_shape +
+make_jaxpr on the CPU backend — nothing executes on devices, nothing
+compiles (the expensive half of the proof is spmd_check --presets).  A
+finding must be fixed or carry a justified plans.WAIVERS entry; a waiver
+matching nothing is itself an error (the PRAGMA002 discipline).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+# Chip-free by construction: force the CPU backend with enough host
+# devices for the fixture meshes BEFORE anything imports jax.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+from pathlib import Path  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dalle_pytorch_tpu.lint import plans  # noqa: E402
+
+
+def run_sweep(presets, select, json_out=None, batch=8) -> int:
+    findings = plans.analyze(presets, select=select, batch=batch)
+    kept, waived, unused = plans.apply_waivers(findings)
+    for f, reason in waived:
+        print(f"waived  {f.render()}  [{reason}]")
+    for f in kept:
+        print(f.render())
+    for msg in unused:
+        print(f"plan_check: {msg}", file=sys.stderr)
+    counts = {}
+    for f in kept:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    if json_out:
+        payload = {
+            "tool": "plan_check",
+            "analyses": list(select),
+            "presets": list(presets),
+            "topologies": [t.name for t in plans.TOPOLOGIES],
+            "batch": batch,
+            "counts": counts,
+            "waived": [{"code": f.code, "cell": f.cell,
+                        "message": f.message, "reason": r}
+                       for f, r in waived],
+            "findings": [{"code": f.code, "cell": f.cell,
+                          "message": f.message} for f in kept],
+        }
+        Path(json_out).write_text(json.dumps(payload, indent=2) + "\n")
+    if kept or unused:
+        summary = ", ".join(f"{c} {code}" for code, c in sorted(
+            counts.items()))
+        print(f"\nplan_check: FAIL — {len(kept)} finding(s) ({summary})"
+              f"{' + stale waivers' if unused else ''}; fix the contract "
+              "or add a justified plans.WAIVERS entry")
+        return 1
+    print(f"plan_check: PASS — {len(presets)} preset(s) x "
+          f"{len(plans.TOPOLOGIES)} topologies clean "
+          f"({', '.join(select)}; {len(waived)} waived)")
+    return 0
+
+
+def selftest() -> int:
+    """Prove P1-P4 have teeth against lint/plans_fixtures.py (the CLI
+    twin of tests/test_plan_check.py): each broken fixture is caught,
+    each clean twin passes."""
+    from dalle_pytorch_tpu.lint import plans_fixtures as fx
+    from dalle_pytorch_tpu.parallel.plan import ParallelPlan
+
+    failures = 0
+
+    def expect(label, ok):
+        nonlocal failures
+        print(f"{'PASS' if ok else 'FAIL'} {label}")
+        failures += 0 if ok else 1
+
+    # P1 orphan leaf
+    broken = plans.check_rule_coverage(fx.ORPHAN_SHAPES, preset="fixture")
+    clean = plans.check_rule_coverage(fx.COVERED_SHAPES, preset="fixture")
+    expect("P1 orphan leaf caught",
+           any("resampler/latents" in f.message for f in broken))
+    expect("P1 covered twin clean", not clean)
+
+    # P1 ambiguous double-match
+    broken = plans.check_rule_coverage(fx.AMBIGUOUS_SHAPES,
+                                       fx.ambiguous_rules(),
+                                       preset="fixture")
+    clean = plans.check_rule_coverage(fx.AMBIGUOUS_SHAPES,
+                                      fx.benign_overlap_rules(),
+                                      preset="fixture")
+    expect("P1 ambiguous rules caught",
+           any("conflicting" in f.message for f in broken))
+    expect("P1 terminal-overlap twin clean", not clean)
+
+    # P2 indivisible axis
+    plan_tp4 = ParallelPlan.parse("tp4")
+    topo = plans.topology("v4-16")
+    broken = plans.check_divisibility(fx.INDIVISIBLE_SHAPES, plan_tp4, topo,
+                                      preset="fixture")
+    clean = plans.check_divisibility(fx.DIVISIBLE_SHAPES, plan_tp4, topo,
+                                     preset="fixture")
+    expect("P2 indivisible heads caught",
+           any("not divisible by tp=4" in f.message for f in broken))
+    expect("P2 divisible twin clean", not clean)
+
+    # P3 overweight state
+    cost = fx.overweight_cost(plans)
+    broken = plans.check_hbm_fit(cost, ParallelPlan.parse("dp"),
+                                 plans.topology("v5e-4"))
+    clean = plans.check_hbm_fit(cost, ParallelPlan.parse("fsdp4"),
+                                plans.topology("v5e-4"))
+    expect("P3 overweight dp state caught",
+           any("exceeds" in f.message for f in broken))
+    expect("P3 fsdp4 twin fits", not clean)
+
+    # P4 dcn-crossing collective
+    plan_dcn = ParallelPlan.parse("dcn2.fsdp2")
+    topo2 = plans.topology("2x-v5e-8")
+    broken = plans.check_collective_placement(
+        plan_dcn, topo2, preset="fixture", jaxpr=fx.dcn_crossing_jaxpr())
+    clean = plans.check_collective_placement(
+        plan_dcn, topo2, preset="fixture", jaxpr=fx.dcn_clean_jaxpr())
+    expect("P4 dcn-crossing all_gather caught",
+           any("all_gather" in f.message for f in broken))
+    expect("P4 psum grad all-reduce twin clean", not clean)
+
+    # P4 structural: fsdp ways spilling over the slice boundary
+    spill = plans.check_collective_placement(
+        ParallelPlan.parse("dcn2.fsdp4.tp2"),
+        plans.Topology("2x-v5e-4", "v5e-4", 8, slices=2), preset="fixture")
+    expect("P4 slice-spill structural caught",
+           any("exceed" in f.message for f in spill))
+
+    print(f"\nselftest: {'FAIL' if failures else 'PASS'}")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--presets", type=str, default=None,
+                        help="comma-separated presets to sweep (default: "
+                             + ",".join(plans.SWEEP_PRESETS) + ")")
+    parser.add_argument("--select", type=str, default=None,
+                        help="comma-separated analyses "
+                             "(default: all of P1,P2,P3,P4)")
+    parser.add_argument("--batch", type=int, default=8,
+                        help="global batch for the divisibility gate")
+    parser.add_argument("--json", type=str, default=None,
+                        help="write machine-readable findings to this path")
+    parser.add_argument("--selftest", action="store_true",
+                        help="prove each analysis catches its deliberately-"
+                             "broken fixture, then exit")
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    select = tuple(plans.ANALYSES)
+    if args.select:
+        select = tuple(s.strip() for s in args.select.split(",") if s.strip())
+        unknown = set(select) - set(plans.ANALYSES)
+        if unknown:
+            print(f"plan_check: unknown analyses {sorted(unknown)} "
+                  f"(have {plans.ANALYSES})", file=sys.stderr)
+            return 2
+    presets = tuple(plans.SWEEP_PRESETS)
+    if args.presets:
+        presets = tuple(s.strip() for s in args.presets.split(",")
+                        if s.strip())
+        from dalle_pytorch_tpu.presets import CONFIG_PRESETS
+        unknown = set(presets) - set(CONFIG_PRESETS)
+        if unknown:
+            print(f"plan_check: unknown presets {sorted(unknown)} "
+                  f"(have {sorted(CONFIG_PRESETS)})", file=sys.stderr)
+            return 2
+    return run_sweep(presets, select, json_out=args.json, batch=args.batch)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""graftplan autotuner — chip-free plan search over (preset x topology x
+batch), committed as a drift-gated ledger (PLAN_LEDGER.json).
+
+Every candidate plan (lint/plans.CANDIDATE_SPECS) runs the P1-P4
+contract gauntlet for the cell; survivors get the analytic roofline
+score (per-device byte stream of sharded state + activation share vs
+the flop floor, plus the DCN all-reduce penalty on multi-slice
+topologies) and the cheapest predicted step wins.  Losers are recorded
+WITH their disqualifying reason — the ledger is the design record of
+why the committed plan registry pairs each rung with its plan, not just
+a winner table.
+
+Usage:
+    python tools/plan_search.py                # print the sweep
+    python tools/plan_search.py --update       # rewrite PLAN_LEDGER.json
+    python tools/plan_search.py --check        # drift gate (CI): exit 1
+                                               #   naming any drifted cell
+    python tools/plan_search.py --json out.json
+
+The fingerprint discipline is PERF_LEDGER's: each cell hashes its
+geometry + topology + batch + candidate set + score-model version, so
+any edit that changes what the sweep would conclude reads as "rerun
+--update and commit the diff", never as silent drift.  Exit codes:
+0 green, 1 drift/missing ledger, 2 usage error.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+# Chip-free: CPU backend, host devices for fixture meshes (before jax).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+from pathlib import Path  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dalle_pytorch_tpu.lint import plans  # noqa: E402
+from dalle_pytorch_tpu.obs import prof  # noqa: E402
+from dalle_pytorch_tpu.parallel.plan import ParallelPlan  # noqa: E402
+
+LEDGER_NAME = "PLAN_LEDGER.json"
+
+#: Relative tolerance on stored scores under --check: the arithmetic is
+#: deterministic, so anything past float-printing noise is a real model
+#: or geometry change that must go through --update.
+SCORE_TOL = 0.02
+
+#: The rungs the ledger pins (tiny is test-geometry only).
+LEDGER_PRESETS = ("cub", "cub-512", "cub-1024")
+
+
+def ledger_path(root=None) -> Path:
+    env = os.environ.get("GRAFT_PLAN_LEDGER")
+    if env:
+        return Path(env)
+    return Path(root or REPO) / LEDGER_NAME
+
+
+def evaluate_candidate(cost, plan: ParallelPlan, topo, batch: int) -> dict:
+    """One candidate through the P1-P4 gauntlet; feasible survivors carry
+    their score, losers their first disqualifying reason."""
+    sizes, why = plans.resolve_axis_sizes(plan, topo)
+    if sizes is None:
+        return {"feasible": False, "reason": why}
+    if (plan.dcn_dp > 1) != (topo.slices > 1):
+        return {"feasible": False,
+                "reason": ("dcn plan needs a multi-slice topology"
+                           if plan.dcn_dp > 1 else
+                           "multi-slice topology needs a dcn plan to pin "
+                           "the slice boundary")}
+    why = plans.batch_infeasible(plan, topo, batch)
+    if why is not None:
+        return {"feasible": False, "reason": why}
+    for check, label in (
+            (lambda: plans.check_divisibility(
+                cost.param_shapes, plan, topo, preset=cost.preset,
+                batch=batch), "P2"),
+            (lambda: plans.check_hbm_fit(cost, plan, topo), "P3"),
+            (lambda: plans.check_collective_placement(
+                plan, topo, preset=cost.preset, jaxpr=cost.jaxpr), "P4")):
+        found = check()
+        if found:
+            return {"feasible": False,
+                    "reason": f"{label}: {found[0].message}"}
+    score = plans.score_cell(cost, plan, topo)
+    return {"feasible": True,
+            "score": {k: (round(v, 9) if isinstance(v, float) else v)
+                      for k, v in score.items()}}
+
+
+def search_cell(preset: str, topo, batch: int) -> dict:
+    """Sweep every candidate for one (preset @ topology / batch) cell and
+    pick the winner: min predicted step time; ties (the common case on
+    flop-bound cells, where the ideal-scaling flop floor is
+    plan-independent) break toward the SMALLER per-step byte stream —
+    deeper state sharding means less HBM traffic to overlap and more
+    headroom, an advantage ``max(flop, byte)`` hides — then toward fewer
+    model-sharding ways (less ICI coupling), then spec name."""
+    cost = plans.preset_cost(preset, batch)
+    candidates = {}
+    for plan in plans.candidate_plans():
+        candidates[plan.spec()] = evaluate_candidate(cost, plan, topo, batch)
+    feasible = sorted(
+        ((spec, c["score"]) for spec, c in candidates.items()
+         if c["feasible"]),
+        key=lambda sc: (sc[1]["pred_step_time_s"],
+                        sc[1]["byte_time_s"],
+                        _model_ways(sc[0]), sc[0]))
+    payload = prof.fingerprint_payload(
+        cost.config, target=f"plan/{preset}", topology=topo.name,
+        chip=topo.chip, devices=topo.devices, slices=topo.slices,
+        batch=batch, score_model=plans.SCORE_MODEL,
+        candidates=",".join(plans.CANDIDATE_SPECS))
+    cell = {
+        "fingerprint": prof.row_fingerprint(payload),
+        "preset": preset,
+        "topology": topo.name,
+        "chip": topo.chip,
+        "devices": topo.devices,
+        "slices": topo.slices,
+        "batch": batch,
+        "score_model": plans.SCORE_MODEL,
+        "winner": feasible[0][0] if feasible else None,
+        "candidates": candidates,
+    }
+    if feasible:
+        cell["score"] = feasible[0][1]
+    else:
+        cell["why_none"] = "; ".join(
+            f"{spec}: {c['reason']}" for spec, c in sorted(
+                candidates.items()))
+    return cell
+
+
+def _model_ways(spec: str) -> int:
+    p = ParallelPlan.parse(spec)
+    return p.fsdp * p.tp * p.sp * p.pp * p.ep
+
+
+def run_search(presets, batch: int) -> dict:
+    cells = {}
+    for preset in presets:
+        for topo in plans.TOPOLOGIES:
+            key = f"{preset}@{topo.name}/b{batch}"
+            cells[key] = search_cell(preset, topo, batch)
+    return {"schema": 1, "tool": "plan_search", "score_model":
+            plans.SCORE_MODEL, "cells": cells}
+
+
+def diff_ledgers(committed: dict, recomputed: dict) -> list:
+    """Human-readable drift problems (empty = green), each naming its
+    cell — the PERF_LEDGER diff discipline."""
+    problems = []
+    old = committed.get("cells", {})
+    new = recomputed.get("cells", {})
+    if committed.get("score_model") != recomputed.get("score_model"):
+        problems.append(
+            f"score_model {committed.get('score_model')} -> "
+            f"{recomputed.get('score_model')}: the scoring arithmetic "
+            "changed — rerun `plan_search.py --update` and commit")
+    for key in sorted(set(old) - set(new)):
+        problems.append(
+            f"{key}: committed but no longer swept — retire it with "
+            "`plan_search.py --update`")
+    for key in sorted(set(new) - set(old)):
+        problems.append(
+            f"{key}: swept but not committed — run "
+            "`plan_search.py --update` and commit the ledger")
+    for key in sorted(set(old) & set(new)):
+        o, n = old[key], new[key]
+        if o.get("fingerprint") != n.get("fingerprint"):
+            problems.append(
+                f"{key}: fingerprint {o.get('fingerprint')} -> "
+                f"{n.get('fingerprint')} — geometry/topology/candidate-set "
+                "drift; rerun --update and review the winner diff")
+            continue
+        if o.get("winner") != n.get("winner"):
+            problems.append(
+                f"{key}: winner {o.get('winner')!r} -> {n.get('winner')!r} "
+                "— the autotuner now picks a different plan; review and "
+                "rerun --update (and move the plan registry if real)")
+            continue
+        os_, ns = o.get("score"), n.get("score")
+        if (os_ is None) != (ns is None):
+            problems.append(f"{key}: score presence changed — rerun "
+                            "--update")
+            continue
+        if os_ is not None:
+            a, b = os_["pred_step_time_s"], ns["pred_step_time_s"]
+            ref = max(abs(a), abs(b), 1e-12)
+            if abs(a - b) / ref > SCORE_TOL:
+                problems.append(
+                    f"{key}: pred_step_time_s {a:.6f} -> {b:.6f} "
+                    f"(>{SCORE_TOL:.0%}) — cost-model drift; rerun "
+                    "--update and commit")
+    return problems
+
+
+def print_sweep(doc: dict):
+    for key, cell in sorted(doc["cells"].items()):
+        if cell["winner"]:
+            s = cell["score"]
+            print(f"{key:28s} winner={cell['winner']:16s} "
+                  f"pred={s['pred_step_time_s'] * 1e3:8.2f} ms "
+                  f"mfu={s['predicted_mfu']:.3f} bound={s['bound']}"
+                  + (f" dcn={s['dcn_time_s'] * 1e3:.1f} ms"
+                     if cell["slices"] > 1 else ""))
+        else:
+            print(f"{key:28s} winner=None (no feasible candidate)")
+        for spec, c in sorted(cell["candidates"].items()):
+            if c["feasible"]:
+                print(f"    {spec:16s} {c['score']['pred_step_time_s'] * 1e3:8.2f} ms")
+            else:
+                print(f"    {spec:16s} infeasible: {c['reason']}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--update", action="store_true",
+                        help=f"rewrite {LEDGER_NAME} from this sweep")
+    parser.add_argument("--check", action="store_true",
+                        help="recompute and diff against the committed "
+                             "ledger; exit 1 naming any drifted cell")
+    parser.add_argument("--presets", type=str, default=None,
+                        help="comma-separated presets "
+                             f"(default: {','.join(LEDGER_PRESETS)})")
+    parser.add_argument("--batch", type=int, default=8,
+                        help="global batch per cell (default 8)")
+    parser.add_argument("--json", type=str, default=None,
+                        help="write the sweep document to this path")
+    parser.add_argument("--ledger", type=str, default=None,
+                        help=f"ledger path (default: repo {LEDGER_NAME}; "
+                             "GRAFT_PLAN_LEDGER env overrides)")
+    args = parser.parse_args(argv)
+    if args.update and args.check:
+        print("plan_search: --update and --check are exclusive",
+              file=sys.stderr)
+        return 2
+    presets = tuple(s.strip() for s in args.presets.split(",")
+                    if s.strip()) if args.presets else LEDGER_PRESETS
+    from dalle_pytorch_tpu.presets import CONFIG_PRESETS
+    unknown = set(presets) - set(CONFIG_PRESETS)
+    if unknown:
+        print(f"plan_search: unknown presets {sorted(unknown)} "
+              f"(have {sorted(CONFIG_PRESETS)})", file=sys.stderr)
+        return 2
+    doc = run_search(presets, args.batch)
+    if args.json:
+        Path(args.json).write_text(json.dumps(doc, indent=1,
+                                              sort_keys=True) + "\n")
+    path = ledger_path() if args.ledger is None else Path(args.ledger)
+    if args.check:
+        if not path.exists():
+            print(f"plan_search: no committed ledger at {path} — run "
+                  "--update and commit", file=sys.stderr)
+            return 1
+        committed = json.loads(path.read_text())
+        problems = diff_ledgers(committed, doc)
+        for p in problems:
+            print(f"plan_search: DRIFT {p}")
+        if problems:
+            print(f"\nplan_search: FAIL — {len(problems)} drifted cell(s)")
+            return 1
+        winners = sum(1 for c in doc["cells"].values() if c["winner"])
+        print(f"plan_search: PASS — {len(doc['cells'])} cells match the "
+              f"committed ledger ({winners} with winners)")
+        return 0
+    print_sweep(doc)
+    if args.update:
+        doc["cells"] = {k: doc["cells"][k] for k in sorted(doc["cells"])}
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+        print(f"\nplan_search: wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

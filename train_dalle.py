@@ -609,7 +609,7 @@ def _main(argv, lr_scale=1.0, skip_past=None):
             dalle, tx, params, part.mesh,
             num_microbatches=args.pipeline_microbatches,
             health=health_on, guard=health_guard)
-        _stage_shard = NamedSharding(part.mesh, P('pp'))
+        _stage_shard = NamedSharding(part.mesh, P('pp'))  # graftlint: disable=PLAN001 (pp stacks stage params on a leading stage dim sharded by POSITION over 'pp' — a structural axis the path-regex rule table cannot name)
 
         def _pp_shard(path, leaf):
             in_stages = any(getattr(k, 'key', None) == 'stages' for k in path)
